@@ -1,0 +1,60 @@
+"""lightgbm_tpu — a TPU-native gradient-boosted-decision-tree framework.
+
+Brand-new JAX/XLA re-design of early LightGBM (reference at
+/root/reference): histogram-based leaf-wise GBDT with serial,
+feature-parallel and data-parallel tree learning — the compute path is
+jitted XLA programs over a dense ``[features, rows]`` bin matrix in HBM, and
+distribution is ``shard_map`` over a ``jax.sharding.Mesh`` with XLA
+collectives instead of sockets/MPI.
+
+Public surface:
+- CLI: ``python -m lightgbm_tpu task=train config=train.conf`` (the
+  reference's ``lightgbm`` executable surface; examples/ configs run
+  unchanged).
+- Python API: :class:`Dataset`, :func:`train`, :class:`GBDT`.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .config import OverallConfig, load_config
+from .io.dataset import Dataset
+from .models.gbdt import GBDT
+from .models.tree import Tree
+
+
+def train(params: dict, train_set: Dataset, valid_sets=(), valid_names=None):
+    """Convenience training entry for library users.
+
+    ``params`` uses the reference's key=value names (aliases applied).
+    """
+    from .config import OverallConfig
+    from .metrics import create_metric
+    from .objectives import create_objective
+
+    config = OverallConfig()
+    config.set({k: str(v) for k, v in params.items()}, require_data=False)
+    booster = GBDT()
+    objective = create_objective(config.objective_type,
+                                 config.objective_config)
+    train_metrics = []
+    if config.boosting_config.is_provide_training_metric:
+        train_metrics = [m for m in
+                         (create_metric(t, config.metric_config)
+                          for t in config.metric_types) if m is not None]
+    learner = None
+    if config.boosting_config.tree_learner != "serial":
+        from .parallel import create_parallel_learner
+        learner = create_parallel_learner(config)
+    booster.init(config.boosting_config, train_set, objective, train_metrics,
+                 learner=learner)
+    for i, valid in enumerate(valid_sets):
+        name = (valid_names[i] if valid_names else f"valid_{i + 1}")
+        metrics = [m for m in (create_metric(t, config.metric_config)
+                               for t in config.metric_types) if m is not None]
+        booster.add_valid_dataset(valid, metrics, name=name)
+    is_eval = bool(train_metrics) or bool(valid_sets)
+    for _ in range(config.boosting_config.num_iterations):
+        if booster.train_one_iter(is_eval=is_eval):
+            break
+    return booster

@@ -1,0 +1,156 @@
+"""CLI application driver: ``lightgbm-tpu key=value ... [config=train.conf]``.
+
+Re-design of /root/reference/src/application/application.cpp:28-302 and
+src/main.cpp.  Same surface: ``task=train|predict``, config files from
+examples/ run unchanged (only ``device_type`` is TPU-specific and optional).
+Distributed runs replace socket/MPI bootstrap (application.cpp:202-205) with
+jax.distributed + a device mesh (lightgbm_tpu/parallel/).
+"""
+from __future__ import annotations
+
+import sys
+import time
+from typing import List
+
+from . import config as config_mod
+from .config import OverallConfig
+from .io.dataset import Dataset
+from .metrics import create_metric
+from .models.gbdt import GBDT
+from .models.predictor import Predictor
+from .objectives import create_objective
+from .utils import log
+
+
+class Application:
+    def __init__(self, argv: List[str]):
+        self.config = config_mod.load_config(argv)
+        self.boosting: GBDT = None
+        self.objective = None
+        self.train_data = None
+        self.valid_datas = []
+
+    def run(self) -> None:
+        if self.config.task_type == "train":
+            self.init_train()
+            self.train()
+        else:
+            self.init_predict()
+            self.predict()
+
+    # -------------------------------------------------------------- training
+
+    def init_train(self) -> None:
+        """Application::InitTrain (application.cpp:201-237)."""
+        learner = None
+        if self.config.is_parallel:
+            from .parallel import create_parallel_learner, sync_up_by_min
+            from .parallel.mesh import init_distributed
+            init_distributed(self.config)
+            # distributed determinism: sync seeds/fractions to global min
+            # (application.cpp:207-214, 133-135)
+            io, tree = self.config.io_config, self.config.boosting_config.tree_config
+            io.data_random_seed = sync_up_by_min(io.data_random_seed)
+            tree.feature_fraction_seed = sync_up_by_min(tree.feature_fraction_seed)
+            tree.feature_fraction = sync_up_by_min(tree.feature_fraction)
+            learner = create_parallel_learner(self.config)
+
+        self.boosting = GBDT()
+        predict_fun = None
+        if self.config.io_config.input_model:
+            cont_model = GBDT.from_model_file(self.config.io_config.input_model)
+            predict_fun = lambda feats: cont_model.predict_raw(feats)
+            self.boosting.models = cont_model.models
+
+        self.objective = create_objective(self.config.objective_type,
+                                          self.config.objective_config)
+        self.load_data(predict_fun)
+        self.boosting.init(self.config.boosting_config, self.train_data,
+                           self.objective, self.train_metrics, learner=learner)
+        for valid_data, metrics, name in self.valid_datas:
+            self.boosting.add_valid_dataset(valid_data, metrics, name=name)
+
+    def load_data(self, predict_fun=None) -> None:
+        """Application::LoadData (application.cpp:119-199)."""
+        start = time.time()
+        num_machines = self.config.network_config.num_machines
+        rank = 0
+        bin_finder = None
+        if self.config.is_parallel:
+            from .parallel import get_rank, distributed_bin_finder
+            rank = get_rank()
+            if self.config.is_parallel_find_bin:
+                bin_finder = distributed_bin_finder(self.config)
+        self.train_data = Dataset.load_train(
+            self.config.io_config, rank=rank, num_machines=num_machines,
+            predict_fun=predict_fun, bin_finder=bin_finder)
+
+        self.train_metrics = []
+        if self.config.boosting_config.is_provide_training_metric:
+            for metric_type in self.config.metric_types:
+                metric = create_metric(metric_type, self.config.metric_config)
+                if metric is not None:
+                    self.train_metrics.append(metric)
+
+        self.valid_datas = []
+        for filename in self.config.io_config.valid_data_filenames:
+            valid = Dataset.load_valid(self.train_data, filename,
+                                       predict_fun=predict_fun,
+                                       io_config=self.config.io_config)
+            metrics = []
+            for metric_type in self.config.metric_types:
+                metric = create_metric(metric_type, self.config.metric_config)
+                if metric is not None:
+                    metrics.append(metric)
+            self.valid_datas.append((valid, metrics, filename))
+        log.info("Finish loading data, use %f seconds" % (time.time() - start))
+
+    def train(self) -> None:
+        """Application::Train (application.cpp:239-257)."""
+        log.info("Start train ...")
+        is_eval = bool(self.train_metrics) or any(
+            m for _, m, _ in self.valid_datas)
+        start = time.time()
+        for it in range(self.config.boosting_config.num_iterations):
+            finished = self.boosting.train_one_iter(is_eval=is_eval)
+            self.boosting.save_model_to_file(
+                False, self.config.io_config.output_model)
+            log.info("%f seconds elapsed, finished %d iteration"
+                     % (time.time() - start, it + 1))
+            if finished:
+                break
+        self.boosting.save_model_to_file(
+            True, self.config.io_config.output_model)
+        log.info("Finished train")
+
+    # ------------------------------------------------------------ prediction
+
+    def init_predict(self) -> None:
+        """Application::InitPredict (application.cpp:269-273)."""
+        if not self.config.io_config.input_model:
+            log.fatal("Please provide a model file for prediction")
+        self.boosting = GBDT.from_model_file(self.config.io_config.input_model)
+
+    def predict(self) -> None:
+        predictor = Predictor(self.boosting, self.config.io_config.is_sigmoid,
+                              self.config.predict_leaf_index,
+                              self.config.io_config.num_model_predict)
+        predictor.predict_file(self.config.io_config.data_filename,
+                               self.config.io_config.output_result,
+                               self.config.io_config.has_header)
+        log.info("Finished prediction")
+
+
+def main(argv: List[str] = None) -> int:
+    """src/main.cpp equivalent."""
+    argv = argv if argv is not None else sys.argv[1:]
+    try:
+        app = Application(argv)
+        app.run()
+    except log.LightGBMError:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

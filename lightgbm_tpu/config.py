@@ -1,0 +1,476 @@
+"""Configuration system.
+
+Re-designs the reference's layered key=value config
+(/root/reference/include/LightGBM/config.h:86-374, src/io/config.cpp:33-331)
+as Python dataclasses.  Behavioral parity goals:
+
+- same parameter names, aliases (config.h:301-374) and defaults,
+- argv ``key=value`` pairs win over config-file lines (application.cpp:98),
+- ``#`` comments in config files,
+- the same conflict-resolution rules (config.cpp:133-182),
+- typed getters that fail loudly on malformed values (config.h:246-299).
+
+TPU additions: ``tree_learner`` keeps the reference's serial/feature/data
+values; ``num_machines``/mesh setup maps to ``jax.sharding.Mesh`` axes rather
+than socket/MPI ranks (see lightgbm_tpu/parallel/).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+from .utils import log
+
+# Alias table: reference config.h:301-374 (KeyAliasTransform).
+ALIAS_TABLE: Dict[str, str] = {
+    "config": "config_file",
+    "nthread": "num_threads",
+    "num_thread": "num_threads",
+    "boosting": "boosting_type",
+    "boost": "boosting_type",
+    "application": "objective",
+    "app": "objective",
+    "train_data": "data",
+    "train": "data",
+    "model_output": "output_model",
+    "model_out": "output_model",
+    "model_input": "input_model",
+    "model_in": "input_model",
+    "init_score": "input_init_score",
+    "predict_result": "output_result",
+    "prediction_result": "output_result",
+    "valid": "valid_data",
+    "test_data": "valid_data",
+    "test": "valid_data",
+    "is_sparse": "is_enable_sparse",
+    "tranining_metric": "is_training_metric",
+    "train_metric": "is_training_metric",
+    "ndcg_at": "ndcg_eval_at",
+    "min_data_per_leaf": "min_data_in_leaf",
+    "min_data": "min_data_in_leaf",
+    "min_sum_hessian_per_leaf": "min_sum_hessian_in_leaf",
+    "min_sum_hessian": "min_sum_hessian_in_leaf",
+    "min_hessian": "min_sum_hessian_in_leaf",
+    "num_leaf": "num_leaves",
+    "sub_feature": "feature_fraction",
+    "num_iteration": "num_iterations",
+    "num_tree": "num_iterations",
+    "num_round": "num_iterations",
+    "num_trees": "num_iterations",
+    "num_rounds": "num_iterations",
+    "sub_row": "bagging_fraction",
+    "shrinkage_rate": "learning_rate",
+    "tree": "tree_learner",
+    "num_machine": "num_machines",
+    "local_port": "local_listen_port",
+    "two_round_loading": "use_two_round_loading",
+    "two_round": "use_two_round_loading",
+    "mlist": "machine_list_file",
+    "is_save_binary": "is_save_binary_file",
+    "save_binary": "is_save_binary_file",
+    "early_stopping_rounds": "early_stopping_round",
+    "early_stopping": "early_stopping_round",
+    "verbosity": "verbose",
+    "header": "has_header",
+    "label": "label_column",
+    "weight": "weight_column",
+    "group": "group_column",
+    "query": "group_column",
+    "query_column": "group_column",
+    "ignore_feature": "ignore_column",
+    "blacklist": "ignore_column",
+}
+
+
+def apply_aliases(params: Dict[str, str]) -> Dict[str, str]:
+    """KeyAliasTransform (config.h:302-373): canonical key wins on conflict."""
+    out = dict(params)
+    for key, value in params.items():
+        canon = ALIAS_TABLE.get(key)
+        if canon is not None and canon not in out:
+            out[canon] = value
+    return out
+
+
+def _get_int(params, name, default):
+    if name in params:
+        try:
+            return int(params[name])
+        except ValueError:
+            log.fatal("Parameter %s should be int type, passed is [%s]" % (name, params[name]))
+    return default
+
+
+def _get_float(params, name, default):
+    if name in params:
+        try:
+            return float(params[name])
+        except ValueError:
+            log.fatal("Parameter %s should be double type, passed is [%s]" % (name, params[name]))
+    return default
+
+
+def _get_bool(params, name, default):
+    if name in params:
+        value = params[name].lower()
+        if value in ("false", "-"):
+            return False
+        if value in ("true", "+"):
+            return True
+        log.fatal('Parameter %s should be "true"/"+" or "false"/"-", passed is [%s]'
+                  % (name, params[name]))
+    return default
+
+
+def _get_str(params, name, default):
+    return params.get(name, default)
+
+
+@dataclasses.dataclass
+class IOConfig:
+    """Reference config.h:86-118."""
+    max_bin: int = 256
+    data_random_seed: int = 1
+    data_filename: str = ""
+    valid_data_filenames: List[str] = dataclasses.field(default_factory=list)
+    output_model: str = "LightGBM_model.txt"
+    output_result: str = "LightGBM_predict_result.txt"
+    input_model: str = ""
+    input_init_score: str = ""
+    verbosity: int = 1
+    num_model_predict: int = -1
+    is_pre_partition: bool = False
+    is_enable_sparse: bool = True
+    use_two_round_loading: bool = False
+    is_save_binary_file: bool = False
+    is_sigmoid: bool = True
+    has_header: bool = False
+    label_column: str = ""
+    weight_column: str = ""
+    group_column: str = ""
+    ignore_column: str = ""
+
+    def set(self, params: Dict[str, str], require_data: bool = True) -> None:
+        self.max_bin = _get_int(params, "max_bin", self.max_bin)
+        log.check(self.max_bin > 0, "max_bin should be > 0")
+        self.data_random_seed = _get_int(params, "data_random_seed", self.data_random_seed)
+        if "data" in params:
+            self.data_filename = params["data"]
+        elif require_data:
+            log.fatal("No training/prediction data, application quit")
+        self.verbosity = _get_int(params, "verbose", self.verbosity)
+        self.num_model_predict = _get_int(params, "num_model_predict", self.num_model_predict)
+        self.is_pre_partition = _get_bool(params, "is_pre_partition", self.is_pre_partition)
+        self.is_enable_sparse = _get_bool(params, "is_enable_sparse", self.is_enable_sparse)
+        self.use_two_round_loading = _get_bool(params, "use_two_round_loading",
+                                               self.use_two_round_loading)
+        self.is_save_binary_file = _get_bool(params, "is_save_binary_file",
+                                             self.is_save_binary_file)
+        self.is_sigmoid = _get_bool(params, "is_sigmoid", self.is_sigmoid)
+        self.output_model = _get_str(params, "output_model", self.output_model)
+        self.input_model = _get_str(params, "input_model", self.input_model)
+        self.output_result = _get_str(params, "output_result", self.output_result)
+        self.input_init_score = _get_str(params, "input_init_score", self.input_init_score)
+        if "valid_data" in params:
+            self.valid_data_filenames = [s for s in params["valid_data"].split(",") if s]
+        self.has_header = _get_bool(params, "has_header", self.has_header)
+        self.label_column = _get_str(params, "label_column", self.label_column)
+        self.weight_column = _get_str(params, "weight_column", self.weight_column)
+        self.group_column = _get_str(params, "group_column", self.group_column)
+        self.ignore_column = _get_str(params, "ignore_column", self.ignore_column)
+
+
+def _default_label_gain() -> List[float]:
+    # label_gain = 2^i - 1 up to 31 labels (config.cpp:226-232).
+    return [0.0] + [float((1 << i) - 1) for i in range(1, 31)]
+
+
+@dataclasses.dataclass
+class ObjectiveConfig:
+    """Reference config.h:120-134."""
+    sigmoid: float = 1.0
+    label_gain: List[float] = dataclasses.field(default_factory=_default_label_gain)
+    max_position: int = 20
+    is_unbalance: bool = False
+    num_class: int = 1
+
+    def set(self, params: Dict[str, str]) -> None:
+        self.is_unbalance = _get_bool(params, "is_unbalance", self.is_unbalance)
+        self.sigmoid = _get_float(params, "sigmoid", self.sigmoid)
+        self.max_position = _get_int(params, "max_position", self.max_position)
+        log.check(self.max_position > 0, "max_position should be > 0")
+        self.num_class = _get_int(params, "num_class", self.num_class)
+        log.check(self.num_class >= 1, "num_class should be >= 1")
+        if "label_gain" in params:
+            self.label_gain = [float(x) for x in params["label_gain"].split(",") if x]
+
+
+@dataclasses.dataclass
+class MetricConfig:
+    """Reference config.h:136-145."""
+    num_class: int = 1
+    sigmoid: float = 1.0
+    label_gain: List[float] = dataclasses.field(default_factory=_default_label_gain)
+    eval_at: List[int] = dataclasses.field(default_factory=lambda: [1, 2, 3, 4, 5])
+
+    def set(self, params: Dict[str, str]) -> None:
+        self.sigmoid = _get_float(params, "sigmoid", self.sigmoid)
+        self.num_class = _get_int(params, "num_class", self.num_class)
+        log.check(self.num_class >= 1, "num_class should be >= 1")
+        if "label_gain" in params:
+            self.label_gain = [float(x) for x in params["label_gain"].split(",") if x]
+        if "ndcg_eval_at" in params:
+            self.eval_at = sorted(int(x) for x in params["ndcg_eval_at"].split(",") if x)
+            for k in self.eval_at:
+                log.check(k > 0, "ndcg_eval_at should be > 0")
+
+
+@dataclasses.dataclass
+class TreeConfig:
+    """Reference config.h:148-165."""
+    min_data_in_leaf: int = 100
+    min_sum_hessian_in_leaf: float = 10.0
+    num_leaves: int = 127
+    feature_fraction_seed: int = 2
+    feature_fraction: float = 1.0
+    histogram_pool_size: float = -1.0
+    max_depth: int = -1
+
+    def set(self, params: Dict[str, str]) -> None:
+        self.min_data_in_leaf = _get_int(params, "min_data_in_leaf", self.min_data_in_leaf)
+        self.min_sum_hessian_in_leaf = _get_float(params, "min_sum_hessian_in_leaf",
+                                                  self.min_sum_hessian_in_leaf)
+        log.check(self.min_sum_hessian_in_leaf > 1.0 or self.min_data_in_leaf > 0,
+                  "min_sum_hessian_in_leaf/min_data_in_leaf check failed")
+        self.num_leaves = _get_int(params, "num_leaves", self.num_leaves)
+        log.check(self.num_leaves > 1, "num_leaves should be > 1")
+        self.feature_fraction_seed = _get_int(params, "feature_fraction_seed",
+                                              self.feature_fraction_seed)
+        self.feature_fraction = _get_float(params, "feature_fraction", self.feature_fraction)
+        log.check(0.0 < self.feature_fraction <= 1.0,
+                  "feature_fraction should be in (0, 1]")
+        self.histogram_pool_size = _get_float(params, "histogram_pool_size",
+                                              self.histogram_pool_size)
+        self.max_depth = _get_int(params, "max_depth", self.max_depth)
+        log.check(self.max_depth > 1 or self.max_depth < 0,
+                  "max_depth should be > 1 or < 0")
+
+
+@dataclasses.dataclass
+class BoostingConfig:
+    """Reference config.h:173-199 (BoostingConfig + GBDTConfig)."""
+    output_freq: int = 1
+    is_provide_training_metric: bool = False
+    num_iterations: int = 10
+    learning_rate: float = 0.1
+    bagging_fraction: float = 1.0
+    bagging_seed: int = 3
+    bagging_freq: int = 0
+    early_stopping_round: int = 0
+    num_class: int = 1
+    tree_learner: str = "serial"
+    tree_config: TreeConfig = dataclasses.field(default_factory=TreeConfig)
+
+    def set(self, params: Dict[str, str]) -> None:
+        self.num_iterations = _get_int(params, "num_iterations", self.num_iterations)
+        log.check(self.num_iterations >= 0, "num_iterations should be >= 0")
+        self.bagging_seed = _get_int(params, "bagging_seed", self.bagging_seed)
+        self.bagging_freq = _get_int(params, "bagging_freq", self.bagging_freq)
+        log.check(self.bagging_freq >= 0, "bagging_freq should be >= 0")
+        self.bagging_fraction = _get_float(params, "bagging_fraction", self.bagging_fraction)
+        log.check(0.0 < self.bagging_fraction <= 1.0,
+                  "bagging_fraction should be in (0, 1]")
+        self.learning_rate = _get_float(params, "learning_rate", self.learning_rate)
+        log.check(self.learning_rate > 0.0, "learning_rate should be > 0")
+        self.early_stopping_round = _get_int(params, "early_stopping_round",
+                                             self.early_stopping_round)
+        log.check(self.early_stopping_round >= 0, "early_stopping_round should be >= 0")
+        self.output_freq = _get_int(params, "metric_freq", self.output_freq)
+        log.check(self.output_freq >= 0, "metric_freq should be >= 0")
+        self.is_provide_training_metric = _get_bool(params, "is_training_metric",
+                                                    self.is_provide_training_metric)
+        self.num_class = _get_int(params, "num_class", self.num_class)
+        log.check(self.num_class >= 1, "num_class should be >= 1")
+        if "tree_learner" in params:
+            value = params["tree_learner"].lower()
+            if value == "serial":
+                self.tree_learner = "serial"
+            elif value in ("feature", "feature_parallel"):
+                self.tree_learner = "feature"
+            elif value in ("data", "data_parallel"):
+                self.tree_learner = "data"
+            else:
+                # reference rejects "voting" in this snapshot (config.cpp:311-313)
+                log.fatal("Tree learner type error")
+        self.tree_config.set(params)
+
+
+@dataclasses.dataclass
+class NetworkConfig:
+    """Reference config.h:201-209.
+
+    On TPU the machine list / listen port map to ``jax.distributed`` process
+    bootstrap; ``num_machines`` becomes the size of the mesh axis used by the
+    parallel tree learners.
+    """
+    num_machines: int = 1
+    local_listen_port: int = 12400
+    time_out: int = 120
+    machine_list_filename: str = ""
+
+    def set(self, params: Dict[str, str]) -> None:
+        self.num_machines = _get_int(params, "num_machines", self.num_machines)
+        log.check(self.num_machines >= 1, "num_machines should be >= 1")
+        self.local_listen_port = _get_int(params, "local_listen_port", self.local_listen_port)
+        log.check(self.local_listen_port > 0, "local_listen_port should be > 0")
+        self.time_out = _get_int(params, "time_out", self.time_out)
+        log.check(self.time_out > 0, "time_out should be > 0")
+        self.machine_list_filename = _get_str(params, "machine_list_file",
+                                              self.machine_list_filename)
+
+
+@dataclasses.dataclass
+class OverallConfig:
+    """Reference config.h:212-243 + config.cpp:33-182."""
+    task_type: str = "train"
+    num_threads: int = 0
+    is_parallel: bool = False
+    is_parallel_find_bin: bool = False
+    predict_leaf_index: bool = False
+    boosting_type: str = "gbdt"
+    objective_type: str = "regression"
+    metric_types: List[str] = dataclasses.field(default_factory=list)
+    network_config: NetworkConfig = dataclasses.field(default_factory=NetworkConfig)
+    io_config: IOConfig = dataclasses.field(default_factory=IOConfig)
+    boosting_config: BoostingConfig = dataclasses.field(default_factory=BoostingConfig)
+    objective_config: ObjectiveConfig = dataclasses.field(default_factory=ObjectiveConfig)
+    metric_config: MetricConfig = dataclasses.field(default_factory=MetricConfig)
+    # TPU addition: device placement for the tree learner ("tpu"/"cpu"; any
+    # value accepted, resolved against jax.devices()).
+    device_type: str = ""
+
+    def set(self, params: Dict[str, str], require_data: bool = True) -> None:
+        params = apply_aliases(params)
+        self.num_threads = _get_int(params, "num_threads", self.num_threads)
+        if "task" in params:
+            value = params["task"].lower()
+            if value in ("train", "training"):
+                self.task_type = "train"
+            elif value in ("predict", "prediction", "test"):
+                self.task_type = "predict"
+            else:
+                log.fatal("Task type error")
+        self.predict_leaf_index = _get_bool(params, "predict_leaf_index",
+                                            self.predict_leaf_index)
+        if "boosting_type" in params:
+            value = params["boosting_type"].lower()
+            if value in ("gbdt", "gbrt"):
+                self.boosting_type = "gbdt"
+            else:
+                log.fatal("Boosting type %s error" % value)
+        if "objective" in params:
+            self.objective_type = params["objective"].lower()
+        if "metric" in params:
+            seen = []
+            for m in params["metric"].lower().split(","):
+                m = m.strip()
+                if m and m not in seen:
+                    seen.append(m)
+            self.metric_types = seen
+        self.device_type = _get_str(params, "device_type", self.device_type)
+        self.network_config.set(params)
+        self.io_config.set(params, require_data=require_data)
+        self.boosting_config.set(params)
+        self.objective_config.set(params)
+        self.metric_config.set(params)
+        self._check_param_conflict()
+        # verbosity → log level (config.cpp:59-70)
+        if self.io_config.verbosity == 1:
+            log.set_level(log.INFO)
+        elif self.io_config.verbosity == 0:
+            log.set_level(log.WARNING)
+        elif self.io_config.verbosity >= 2:
+            log.set_level(log.DEBUG)
+        else:
+            log.set_level(log.FATAL)
+
+    def _check_param_conflict(self) -> None:
+        """Reference config.cpp:133-182."""
+        objective_multiclass = self.objective_type == "multiclass"
+        num_class = self.boosting_config.num_class
+        if objective_multiclass:
+            if num_class <= 1:
+                log.fatal("You should specify number of class(>=2) for multiclass training.")
+        else:
+            if self.task_type == "train" and num_class != 1:
+                log.fatal("Number of class must be 1 for non-multiclass training.")
+        for metric_type in self.metric_types:
+            metric_multiclass = metric_type in ("multi_logloss", "multi_error")
+            if objective_multiclass != metric_multiclass:
+                log.fatal("Objective and metrics don't match.")
+        if self.network_config.num_machines > 1:
+            self.is_parallel = True
+        else:
+            self.is_parallel = False
+            self.boosting_config.tree_learner = "serial"
+        if self.boosting_config.tree_learner == "serial":
+            self.is_parallel = False
+            self.network_config.num_machines = 1
+        if self.boosting_config.tree_learner in ("serial", "feature"):
+            self.is_parallel_find_bin = False
+        elif self.boosting_config.tree_learner == "data":
+            self.is_parallel_find_bin = True
+            if self.boosting_config.tree_config.histogram_pool_size >= 0:
+                log.warning(
+                    "Histogram LRU queue was enabled (histogram_pool_size=%f). "
+                    "Will disable this for reducing communication cost."
+                    % self.boosting_config.tree_config.histogram_pool_size)
+                self.boosting_config.tree_config.histogram_pool_size = -1
+
+
+def parse_config_file(path: str) -> Dict[str, str]:
+    """Parse a .conf file: ``key = value`` lines, ``#`` comments
+    (application.cpp:78-113)."""
+    params: Dict[str, str] = {}
+    with open(path, "r") as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            if "=" not in line:
+                continue
+            key, value = line.split("=", 1)
+            key = key.strip().strip('"').strip("'")
+            value = value.strip().strip('"').strip("'")
+            if key:
+                params[key] = value
+    return params
+
+
+def parse_argv(args: List[str]) -> Dict[str, str]:
+    """Parse CLI ``key=value`` tokens (application.cpp:59-76)."""
+    params: Dict[str, str] = {}
+    for arg in args:
+        if "=" not in arg:
+            log.warning("Unknown parameter %s" % arg)
+            continue
+        key, value = arg.split("=", 1)
+        key = key.strip().strip('"').strip("'")
+        value = value.strip().strip('"').strip("'")
+        if key:
+            params[key] = value
+    return params
+
+
+def load_config(argv: List[str]) -> OverallConfig:
+    """argv pairs + optional config file; argv wins (application.cpp:98)."""
+    cli_params = parse_argv(argv)
+    cli_params = apply_aliases(cli_params)
+    params: Dict[str, str] = {}
+    if "config_file" in cli_params:
+        params.update(parse_config_file(cli_params["config_file"]))
+    # argv has higher priority
+    params.update(cli_params)
+    config = OverallConfig()
+    config.set(params)
+    return config

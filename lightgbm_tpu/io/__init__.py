@@ -1,0 +1,4 @@
+"""Host-side data layer: parsers, binning, Dataset, Metadata."""
+from .dataset import Dataset
+from .binning import BinMapper
+from .metadata import Metadata

@@ -1,0 +1,130 @@
+"""Metadata: labels, weights, query boundaries, init scores.
+
+Re-design of /root/reference/src/io/metadata.cpp:10-369 and
+include/LightGBM/dataset.h:34-207 as a NumPy container.  Side-file
+conventions preserved: ``<data>.weight`` (one weight per line),
+``<data>.query`` (one per-query document count per line), plus an optional
+explicit init-score file.  Query-id columns in the data file are converted to
+boundaries exactly like Metadata::CheckOrPartition (metadata.cpp:79-106).
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from ..utils import log
+
+
+class Metadata:
+    def __init__(self):
+        self.num_data: int = 0
+        self.label: Optional[np.ndarray] = None            # float32 [N]
+        self.weights: Optional[np.ndarray] = None          # float32 [N]
+        self.query_boundaries: Optional[np.ndarray] = None  # int32 [num_queries+1]
+        self.query_weights: Optional[np.ndarray] = None    # float32 [num_queries]
+        self.init_score: Optional[np.ndarray] = None       # float32 [N]
+        self.queries: Optional[np.ndarray] = None          # raw per-row query ids
+
+    # --- loading (metadata.cpp:228-299) ---
+
+    def init_from_files(self, data_filename: str, init_score_filename: str = "") -> None:
+        self._load_query_boundaries(data_filename + ".query")
+        self._load_weights(data_filename + ".weight")
+        self._load_query_weights()
+        if init_score_filename:
+            self._load_init_score(init_score_filename)
+
+    def _load_weights(self, path: str) -> None:
+        if not os.path.exists(path):
+            return
+        log.info("Start loading weights")
+        self.weights = np.loadtxt(path, dtype=np.float64, ndmin=1).astype(np.float32)
+
+    def _load_query_boundaries(self, path: str) -> None:
+        if not os.path.exists(path):
+            return
+        log.info("Start loading query boundries")
+        counts = np.loadtxt(path, dtype=np.int64, ndmin=1)
+        boundaries = np.zeros(counts.size + 1, dtype=np.int32)
+        boundaries[1:] = np.cumsum(counts)
+        self.query_boundaries = boundaries
+
+    def _load_init_score(self, path: str) -> None:
+        log.info("Start loading initial scores")
+        self.init_score = np.loadtxt(path, dtype=np.float64, ndmin=1).astype(np.float32)
+
+    def _load_query_weights(self) -> None:
+        """Per-query mean of record weights (metadata.cpp:285-299)."""
+        if self.weights is None or self.query_boundaries is None:
+            return
+        log.info("Start loading query weights")
+        nq = self.query_boundaries.size - 1
+        qw = np.zeros(nq, dtype=np.float32)
+        for i in range(nq):
+            lo, hi = self.query_boundaries[i], self.query_boundaries[i + 1]
+            qw[i] = self.weights[lo:hi].mean() if hi > lo else 0.0
+        self.query_weights = qw
+
+    # --- finalization (metadata.cpp:79-160 CheckOrPartition, no-partition path) ---
+
+    def set_label(self, label: np.ndarray) -> None:
+        self.label = np.asarray(label, dtype=np.float32)
+        self.num_data = self.label.size
+
+    def set_queries_from_column(self, queries: np.ndarray) -> None:
+        """Query-id column → boundaries (metadata.cpp:81-106): a new query
+        starts whenever the id changes."""
+        self.queries = np.asarray(queries)
+
+    def finalize(self, num_data: int) -> None:
+        self.num_data = num_data
+        if self.queries is not None:
+            q = self.queries
+            change = np.nonzero(q[1:] != q[:-1])[0] + 1
+            starts = np.concatenate(([0], change, [q.size]))
+            self.query_boundaries = starts.astype(np.int32)
+            self._load_query_weights()
+            self.queries = None
+        if self.weights is not None and self.weights.size != num_data:
+            log.fatal("Initial weight size doesn't equal to data")
+        if (self.query_boundaries is not None
+                and self.query_boundaries[-1] != num_data):
+            log.fatal("Initial query size doesn't equal to data")
+        if self.init_score is not None and self.init_score.size != num_data:
+            log.fatal("Initial score size doesn't equal to data")
+
+    def partition(self, used_indices: np.ndarray, num_all_data: int) -> None:
+        """Distributed load: slice side data down to this worker's rows
+        (metadata.cpp:130-212)."""
+        used_indices = np.asarray(used_indices)
+        if self.weights is not None:
+            if self.weights.size != num_all_data:
+                log.fatal("Initial weights size doesn't equal to data")
+            self.weights = self.weights[used_indices]
+        if self.query_boundaries is not None:
+            if self.query_boundaries[-1] != num_all_data:
+                log.fatal("Initial query size doesn't equal to data")
+            # keep only queries fully owned by this worker; sharding is
+            # query-atomic (dataset.cpp:195-215) so membership is per-query
+            row_query = np.searchsorted(self.query_boundaries, used_indices,
+                                        side="right") - 1
+            kept_queries, counts = np.unique(row_query, return_counts=True)
+            boundaries = np.zeros(kept_queries.size + 1, dtype=np.int32)
+            boundaries[1:] = np.cumsum(counts)
+            self.query_boundaries = boundaries
+            self._load_query_weights()
+        if self.init_score is not None:
+            if self.init_score.size != num_all_data:
+                log.fatal("Initial score size doesn't equal to data")
+            self.init_score = self.init_score[used_indices]
+        if self.label is not None:
+            self.label = self.label[used_indices]
+        self.num_data = used_indices.size
+
+    @property
+    def num_queries(self) -> int:
+        if self.query_boundaries is None:
+            return 0
+        return self.query_boundaries.size - 1

@@ -1,0 +1,261 @@
+"""Evaluation metrics.
+
+Re-design of /root/reference/src/metric/ as NumPy evaluators (metrics run
+once per iteration on host-resident score vectors).  Factory mirrors
+metric.cpp:9-28; display names and Eval semantics match the reference
+(weighted means, L2 reported as RMSE, AUC tie handling, NDCG per-k with
+all-negative queries scoring 1.0).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..utils import log
+from .dcg import DCGCalculator
+
+
+class Metric:
+    name: str = ""
+    is_bigger_better: bool = False
+
+    def init(self, test_name: str, metadata, num_data: int) -> None:
+        raise NotImplementedError
+
+    def eval(self, score: np.ndarray) -> List[float]:
+        raise NotImplementedError
+
+
+class _PointwiseMetric(Metric):
+    """Weighted-mean pointwise losses (regression_metric.hpp:16-121,
+    binary_metric.hpp:18-141, multiclass_metric.hpp:16-135)."""
+    loss_name = ""
+
+    def __init__(self, config):
+        self.config = config
+        self.weights = None
+
+    def init(self, test_name, metadata, num_data):
+        self.name = f"{test_name}'s {self.loss_name}"
+        self.num_data = num_data
+        self.label = np.asarray(metadata.label)
+        self.weights = (np.asarray(metadata.weights)
+                        if metadata.weights is not None else None)
+        self.sum_weights = (float(self.weights.sum())
+                            if self.weights is not None else float(num_data))
+
+    def eval(self, score):
+        loss = self._point_loss(score)
+        if self.weights is not None:
+            loss = loss * self.weights
+        return [self._transform(float(loss.sum()) / self.sum_weights)]
+
+    def _transform(self, mean_loss: float) -> float:
+        return mean_loss
+
+    def _point_loss(self, score):
+        raise NotImplementedError
+
+
+class L2Metric(_PointwiseMetric):
+    loss_name = "l2 loss"
+
+    def _point_loss(self, score):
+        d = score - self.label
+        return d * d
+
+    def _transform(self, mean_loss):
+        # L2 metric reports RMSE (regression_metric.hpp:100-103)
+        return float(np.sqrt(mean_loss))
+
+
+class L1Metric(_PointwiseMetric):
+    loss_name = "l1 loss"
+
+    def _point_loss(self, score):
+        return np.abs(score - self.label)
+
+
+class _BinaryMetric(_PointwiseMetric):
+    def __init__(self, config):
+        super().__init__(config)
+        self.sigmoid = float(config.sigmoid)
+        if self.sigmoid <= 0.0:
+            log.fatal("Sigmoid param %f should greater than zero" % self.sigmoid)
+
+    def _prob(self, score):
+        return 1.0 / (1.0 + np.exp(-2.0 * self.sigmoid * score))
+
+
+class BinaryLoglossMetric(_BinaryMetric):
+    loss_name = "log loss"
+
+    def _point_loss(self, score):
+        prob = self._prob(score)
+        # LossOnPoint (binary_metric.hpp:105-126): -log(p) label-sided
+        eps = 1e-15
+        prob = np.clip(prob, eps, 1 - eps)
+        return np.where(self.label == 1, -np.log(prob), -np.log(1.0 - prob))
+
+
+class BinaryErrorMetric(_BinaryMetric):
+    loss_name = "error rate"
+
+    def _point_loss(self, score):
+        prob = self._prob(score)
+        # error rate (binary_metric.hpp:131-141): prob>0.5 predicted positive
+        pred_pos = prob > 0.5
+        return np.where(pred_pos == (self.label == 1), 0.0, 1.0)
+
+
+class AUCMetric(Metric):
+    """AUC with tie handling (binary_metric.hpp:146-254)."""
+    is_bigger_better = True
+
+    def __init__(self, config):
+        self.weights = None
+
+    def init(self, test_name, metadata, num_data):
+        self.name = f"{test_name}'s AUC"
+        self.num_data = num_data
+        self.label = np.asarray(metadata.label)
+        self.weights = (np.asarray(metadata.weights)
+                        if metadata.weights is not None else None)
+        self.sum_weights = (float(self.weights.sum())
+                            if self.weights is not None else float(num_data))
+
+    def eval(self, score):
+        score = np.asarray(score)
+        label = self.label
+        w = self.weights if self.weights is not None else np.ones_like(label)
+        order = np.argsort(-score, kind="stable")
+        s, l, wt = score[order], label[order], w[order]
+        pos = l * wt
+        neg = (1.0 - l) * wt
+        # group ties: boundaries where score changes
+        change = np.nonzero(s[1:] != s[:-1])[0] + 1
+        starts = np.concatenate(([0], change))
+        grp_pos = np.add.reduceat(pos, starts)
+        grp_neg = np.add.reduceat(neg, starts)
+        pos_before = np.cumsum(grp_pos) - grp_pos
+        accum = float(np.sum(grp_neg * (grp_pos * 0.5 + pos_before)))
+        sum_pos = float(grp_pos.sum())
+        auc = 1.0
+        if sum_pos > 0.0 and sum_pos != self.sum_weights:
+            auc = accum / (sum_pos * (self.sum_weights - sum_pos))
+        return [auc]
+
+
+class _MulticlassMetric(Metric):
+    """Score layout [K, N] flattened row-major like the reference's
+    score[k * num_data + i] (multiclass_metric.hpp:49-94)."""
+
+    def __init__(self, config):
+        self.num_class = int(config.num_class)
+        self.weights = None
+
+    def init(self, test_name, metadata, num_data):
+        self.name = f"{test_name}'s {self.loss_name}"
+        self.num_data = num_data
+        self.label = np.asarray(metadata.label).astype(np.int64)
+        self.weights = (np.asarray(metadata.weights)
+                        if metadata.weights is not None else None)
+        self.sum_weights = (float(self.weights.sum())
+                            if self.weights is not None else float(num_data))
+
+    def eval(self, score):
+        score = np.asarray(score).reshape(self.num_class, self.num_data)
+        loss = self._point_loss(score)
+        if self.weights is not None:
+            loss = loss * self.weights
+        return [float(loss.sum()) / self.sum_weights]
+
+
+class MultiErrorMetric(_MulticlassMetric):
+    loss_name = "multi error"
+
+    def _point_loss(self, score):
+        pred = np.argmax(score, axis=0)
+        return np.where(pred == self.label, 0.0, 1.0)
+
+
+class MultiLoglossMetric(_MulticlassMetric):
+    loss_name = "multi logloss"
+
+    def _point_loss(self, score):
+        z = score - score.max(axis=0, keepdims=True)
+        p = np.exp(z)
+        p = p / p.sum(axis=0, keepdims=True)
+        eps = 1e-15
+        picked = np.clip(p[self.label, np.arange(self.num_data)], eps, 1.0)
+        return -np.log(picked)
+
+
+class NDCGMetric(Metric):
+    """NDCG@ks (rank_metric.hpp:16-167)."""
+    is_bigger_better = True
+
+    def __init__(self, config):
+        self.eval_at = list(config.eval_at)
+        self.dcg = DCGCalculator(config.label_gain)
+
+    def init(self, test_name, metadata, num_data):
+        self.name = (f"{test_name}'s "
+                     + " ".join(f"NDCG@{k}" for k in self.eval_at))
+        self.num_data = num_data
+        self.label = np.asarray(metadata.label)
+        if metadata.query_boundaries is None:
+            log.fatal("For NDCG metric, there should be query information")
+        self.boundaries = np.asarray(metadata.query_boundaries)
+        self.query_weights = metadata.query_weights
+        nq = self.boundaries.size - 1
+        self.sum_query_weights = (float(np.sum(self.query_weights))
+                                  if self.query_weights is not None
+                                  else float(nq))
+        # cache inverse max DCG per query; ≤0 ⇒ all-negative query → NDCG 1
+        self.inv_max = []
+        for q in range(nq):
+            lo, hi = self.boundaries[q], self.boundaries[q + 1]
+            maxes = self.dcg.cal_max_dcg(self.eval_at, self.label[lo:hi])
+            self.inv_max.append([1.0 / m if m > 0 else -1.0 for m in maxes])
+
+    def eval(self, score):
+        score = np.asarray(score)
+        nq = self.boundaries.size - 1
+        result = np.zeros(len(self.eval_at))
+        for q in range(nq):
+            lo, hi = self.boundaries[q], self.boundaries[q + 1]
+            w = (float(self.query_weights[q])
+                 if self.query_weights is not None else 1.0)
+            if self.inv_max[q][0] <= 0.0:
+                # all-negative query counts as 1.0 even when weighted —
+                # reference quirk (rank_metric.hpp:98-101, 120-124)
+                result += 1.0
+                continue
+            dcgs = self.dcg.cal_dcg(self.eval_at, self.label[lo:hi],
+                                    score[lo:hi])
+            for j, d in enumerate(dcgs):
+                result[j] += d * self.inv_max[q][j] * w
+        return [float(r / self.sum_query_weights) for r in result]
+
+
+def create_metric(metric_type: str, config) -> Optional[Metric]:
+    """CreateMetric (metric.cpp:9-28)."""
+    if metric_type == "l2":
+        return L2Metric(config)
+    if metric_type == "l1":
+        return L1Metric(config)
+    if metric_type == "auc":
+        return AUCMetric(config)
+    if metric_type == "binary_logloss":
+        return BinaryLoglossMetric(config)
+    if metric_type == "binary_error":
+        return BinaryErrorMetric(config)
+    if metric_type == "ndcg":
+        return NDCGMetric(config)
+    if metric_type == "multi_logloss":
+        return MultiLoglossMetric(config)
+    if metric_type == "multi_error":
+        return MultiErrorMetric(config)
+    return None

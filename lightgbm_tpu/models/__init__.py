@@ -1,0 +1,3 @@
+"""Model layer: Tree, grower, GBDT booster, predictor."""
+from .tree import Tree
+from .gbdt import GBDT

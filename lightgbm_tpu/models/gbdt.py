@@ -1,0 +1,447 @@
+"""GBDT boosting loop.
+
+Re-design of /root/reference/src/boosting/gbdt.cpp:19-521 (+ gbdt.h,
+score_updater.hpp, boosting.cpp factory).  The host drives iterations; each
+iteration's compute — gradients, tree growth, score updates — runs as jitted
+device programs on the [F, N] bin matrix.  Per-class trees are interleaved
+``models_[iter*num_class + k]`` exactly like gbdt.cpp:175-195.
+
+Score maintenance (ScoreUpdater, score_updater.hpp:15-77) is a device
+array [num_class, N]; the leaf-id vector returned by the grower covers ALL
+rows (in-bag and out-of-bag), so the reference's separate OOB traversal path
+(gbdt.cpp:159-165) collapses into one gather.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..utils import log
+from ..ops.scoring import add_tree_score
+from .grower import grow_tree
+from .tree import Tree
+
+
+class GBDT:
+    def __init__(self, config=None):
+        self.config = config
+        self.models: List[Tree] = []
+        self.num_class = 1
+        self.label_idx = 0
+        self.max_feature_idx = 0
+        self.sigmoid = -1.0
+        self.iter = 0
+        self.train_data = None
+        self.objective = None
+        self.training_metrics = []
+        self.valid_datasets = []
+        self.valid_metrics = []
+        self.best_score = []
+        self.best_iter = []
+        self.early_stopping_round = 0
+        self._saved_model_size = -1
+        self._model_file = None
+        self._learner_factory: Optional[Callable] = None
+
+    # ------------------------------------------------------------------ init
+
+    def init(self, boosting_config, train_data, objective,
+             training_metrics=(), learner=None) -> None:
+        """GBDT::Init (gbdt.cpp:41-89).  ``learner`` optionally overrides the
+        tree-growing callable (serial default; parallel learners plug in via
+        lightgbm_tpu.parallel)."""
+        self.gbdt_config = boosting_config
+        self.tree_config = boosting_config.tree_config
+        self.train_data = train_data
+        self.objective = objective
+        self.num_class = boosting_config.num_class
+        self.early_stopping_round = boosting_config.early_stopping_round
+        self.training_metrics = list(training_metrics)
+        self.max_feature_idx = train_data.num_total_features - 1
+        self.label_idx = train_data.label_idx
+        self.sigmoid = objective.sigmoid if objective is not None else -1.0
+        self._learner = learner or _serial_learner
+
+        N = train_data.num_data
+        self.num_data = N
+        self.bins_device = jnp.asarray(train_data.bins)
+        self.num_bins_device = jnp.asarray(train_data.num_bins)
+        self.num_bins_max = int(train_data.num_bins.max())
+        self.num_features = train_data.num_features
+
+        # score state [num_class, N] (ScoreUpdater init from init_score,
+        # score_updater.hpp:27-33)
+        init_score = train_data.metadata.init_score
+        if init_score is not None:
+            score0 = np.tile(np.asarray(init_score, np.float32), (self.num_class, 1))
+        else:
+            score0 = np.zeros((self.num_class, N), np.float32)
+        self.score = jnp.asarray(score0)
+
+        # bagging state (gbdt.cpp:77-88)
+        self._bag_rng = np.random.RandomState(boosting_config.bagging_seed)
+        self._use_bagging = (boosting_config.bagging_fraction < 1.0
+                             and boosting_config.bagging_freq > 0)
+        self._bag_mask = np.ones(N, dtype=bool)
+        # per-class feature-fraction RNGs, same seed each
+        # (serial_tree_learner.cpp:159-167; one learner per class)
+        self._feat_rngs = [np.random.RandomState(self.tree_config.feature_fraction_seed)
+                           for _ in range(self.num_class)]
+
+        if objective is not None:
+            objective.init(train_data.metadata, N)
+        for metric in self.training_metrics:
+            metric.init("training", train_data.metadata, N)
+
+    def add_valid_dataset(self, valid_data, valid_metrics, name=None) -> None:
+        """GBDT::AddDataset (gbdt.cpp:92-105)."""
+        idx = len(self.valid_datasets)
+        name = name or f"valid_{idx + 1}"
+        entry = {
+            "data": valid_data,
+            "bins": jnp.asarray(valid_data.bins),
+            "score": jnp.asarray(
+                np.tile(valid_data.metadata.init_score, (self.num_class, 1))
+                if valid_data.metadata.init_score is not None
+                else np.zeros((self.num_class, valid_data.num_data), np.float32)),
+            "name": name,
+        }
+        self.valid_datasets.append(entry)
+        for metric in valid_metrics:
+            metric.init(name, valid_data.metadata, valid_data.num_data)
+        self.valid_metrics.append(list(valid_metrics))
+        self.best_score.append([-1.0] * len(valid_metrics))
+        self.best_iter.append([0] * len(valid_metrics))
+
+    # ------------------------------------------------------------- iteration
+
+    def _bagging(self, it: int) -> None:
+        """GBDT::Bagging (gbdt.cpp:106-157): per-record, or per-query when
+        query boundaries exist."""
+        if not self._use_bagging or it % self.gbdt_config.bagging_freq != 0:
+            return
+        frac = self.gbdt_config.bagging_fraction
+        qb = self.train_data.metadata.query_boundaries
+        mask = np.zeros(self.num_data, dtype=bool)
+        if qb is None:
+            bag_cnt = int(frac * self.num_data)
+            idx = self._bag_rng.choice(self.num_data, bag_cnt, replace=False)
+            mask[idx] = True
+        else:
+            nq = qb.size - 1
+            bag_q = int(nq * frac)
+            qidx = self._bag_rng.choice(nq, bag_q, replace=False)
+            for q in qidx:
+                mask[qb[q]:qb[q + 1]] = True
+            bag_cnt = int(mask.sum())
+        log.info("re-bagging, using %d data to train" % bag_cnt)
+        self._bag_mask = mask
+
+    def _feature_sample(self, cls: int) -> np.ndarray:
+        frac = self.tree_config.feature_fraction
+        F = self.num_features
+        if frac >= 1.0:
+            return np.ones(F, dtype=bool)
+        used_cnt = max(int(F * frac), 1)
+        mask = np.zeros(F, dtype=bool)
+        mask[self._feat_rngs[cls].choice(F, used_cnt, replace=False)] = True
+        return mask
+
+    def train_one_iter(self, is_eval: bool = True) -> bool:
+        """GBDT::TrainOneIter (gbdt.cpp:167-214).  Returns True when
+        training must stop (early stopping or no splittable leaf)."""
+        grad, hess = self.objective.get_gradients(
+            self.score if self.num_class > 1 else self.score[0])
+        if self.num_class == 1:
+            grad = grad[None]
+            hess = hess[None]
+
+        for cls in range(self.num_class):
+            self._bagging(self.iter)
+            feature_mask = self._feature_sample(cls)
+            row_mask = jnp.asarray(self._bag_mask)
+
+            tree_arrays = self._learner(
+                self, self.bins_device, grad[cls], hess[cls], row_mask,
+                jnp.asarray(feature_mask))
+
+            num_leaves = int(tree_arrays.num_leaves)
+            if num_leaves <= 1:
+                log.info("Can't training anymore, there isn't any leaf meets "
+                         "split requirements.")
+                return True
+
+            tree = self._to_host_tree(tree_arrays)
+            tree.shrinkage(self.gbdt_config.learning_rate)
+            # train score via leaf partition (fast path, gbdt.cpp:216-218 +
+            # OOB, 159-165 — unified because leaf_ids cover all rows)
+            leaf_values = jnp.asarray(tree.leaf_value, jnp.float32)
+            self.score = self.score.at[cls].add(
+                leaf_values[tree_arrays.leaf_ids])
+            # valid scores via tree replay (gbdt.cpp:220-222); node arrays
+            # are padded to the static num_leaves-1 so add_tree_score
+            # compiles exactly once regardless of each tree's actual size
+            if self.valid_datasets:
+                max_nodes = max(_effective_num_leaves(self.tree_config) - 1, 1)
+
+                def pad_nodes(arr, fill=0):
+                    out = np.full(max_nodes, fill, dtype=np.asarray(arr).dtype)
+                    out[:len(arr)] = arr
+                    return jnp.asarray(out)
+
+                leaf_vals = np.zeros(max_nodes + 1, dtype=np.float32)
+                leaf_vals[:tree.num_leaves] = tree.leaf_value
+                for entry in self.valid_datasets:
+                    entry["score"] = entry["score"].at[cls].set(
+                        add_tree_score(
+                            entry["bins"], entry["score"][cls],
+                            pad_nodes(tree.split_feature),
+                            pad_nodes(tree.threshold_bin),
+                            pad_nodes(tree.left_child),
+                            pad_nodes(tree.right_child),
+                            jnp.asarray(leaf_vals),
+                            jnp.asarray(tree.num_leaves),
+                            max_nodes=max_nodes))
+            self.models.append(tree)
+
+        met_early_stopping = False
+        if is_eval:
+            met_early_stopping = self.output_metric(self.iter + 1)
+        self.iter += 1
+        if met_early_stopping:
+            log.info("Early stopping at iteration %d, the best iteration "
+                     "round is %d"
+                     % (self.iter, self.iter - self.early_stopping_round))
+            # pop back the last early_stopping_round models (gbdt.cpp:205-210)
+            del self.models[len(self.models)
+                            - self.early_stopping_round * self.num_class:]
+        return met_early_stopping
+
+    def _to_host_tree(self, tree_arrays) -> Tree:
+        n = int(tree_arrays.num_leaves)
+        split_feature = np.asarray(tree_arrays.split_feature)[:n - 1]
+        threshold_bin = np.asarray(tree_arrays.threshold_bin)[:n - 1]
+        # real-valued thresholds from bin upper bounds in float64 on host
+        # (serial_tree_learner.cpp:418 BinToValue)
+        thresholds = np.array(
+            [self.train_data.bin_mappers[f].bin_to_value(t)
+             for f, t in zip(split_feature, threshold_bin)], dtype=np.float64)
+        real_feature = self.train_data.real_feature_idx[split_feature]
+        return Tree(
+            num_leaves=n,
+            split_feature=split_feature,
+            split_feature_real=real_feature,
+            threshold_bin=threshold_bin,
+            threshold=thresholds,
+            split_gain=np.asarray(tree_arrays.split_gain, np.float64)[:n - 1],
+            left_child=np.asarray(tree_arrays.left_child)[:n - 1],
+            right_child=np.asarray(tree_arrays.right_child)[:n - 1],
+            leaf_parent=np.asarray(tree_arrays.leaf_parent)[:n],
+            leaf_value=np.asarray(tree_arrays.leaf_value, np.float64)[:n],
+        )
+
+    # --------------------------------------------------------------- metrics
+
+    def output_metric(self, iteration: int) -> bool:
+        """GBDT::OutputMetric (gbdt.cpp:225-259)."""
+        ret = False
+        freq = self.gbdt_config.output_freq
+        if freq > 0 and iteration % freq == 0:
+            score_np = np.asarray(self.score)
+            for metric in self.training_metrics:
+                values = metric.eval(score_np.reshape(-1)
+                                     if self.num_class > 1 else score_np[0])
+                log.info("Iteration:%d, %s : %s"
+                         % (iteration, metric.name,
+                            " ".join(str(v) for v in values)))
+        for i, entry in enumerate(self.valid_datasets):
+            eval_now = (freq > 0 and iteration % freq == 0)
+            if not eval_now and self.early_stopping_round <= 0:
+                continue
+            score_np = np.asarray(entry["score"])
+            for j, metric in enumerate(self.valid_metrics[i]):
+                values = metric.eval(score_np.reshape(-1)
+                                     if self.num_class > 1 else score_np[0])
+                if eval_now:
+                    log.info("Iteration:%d, %s : %s"
+                             % (iteration, metric.name,
+                                " ".join(str(v) for v in values)))
+                if not ret and self.early_stopping_round > 0:
+                    bigger_better = metric.is_bigger_better
+                    last = values[-1]
+                    if (self.best_score[i][j] < 0
+                            or (not bigger_better and last < self.best_score[i][j])
+                            or (bigger_better and last > self.best_score[i][j])):
+                        self.best_score[i][j] = last
+                        self.best_iter[i][j] = iteration
+                    elif iteration - self.best_iter[i][j] >= self.early_stopping_round:
+                        ret = True
+        return ret
+
+    # ------------------------------------------------------------ prediction
+
+    def predict_raw(self, features: np.ndarray,
+                    num_used_model: int = -1) -> np.ndarray:
+        """Batch PredictRaw (gbdt.cpp:470-479); features [N, cols] raw."""
+        if num_used_model < 0:
+            num_used_model = len(self.models)
+        out = np.zeros(features.shape[0], dtype=np.float64)
+        for tree in self.models[:num_used_model]:
+            out += tree.predict(features)
+        return out
+
+    def predict(self, features: np.ndarray,
+                num_used_model: int = -1) -> np.ndarray:
+        """Predict with sigmoid transform when applicable (gbdt.cpp:481-494)."""
+        ret = self.predict_raw(features, num_used_model)
+        if self.sigmoid > 0:
+            ret = 1.0 / (1.0 + np.exp(-2.0 * self.sigmoid * ret))
+        return ret
+
+    def predict_multiclass(self, features: np.ndarray,
+                           num_used_model: int = -1) -> np.ndarray:
+        """[N, num_class] softmax probabilities (gbdt.cpp:496-508)."""
+        if num_used_model < 0:
+            num_used_model = len(self.models) // self.num_class
+        out = np.zeros((features.shape[0], self.num_class), dtype=np.float64)
+        for i in range(num_used_model):
+            for j in range(self.num_class):
+                out[:, j] += self.models[i * self.num_class + j].predict(features)
+        z = out - out.max(axis=1, keepdims=True)
+        p = np.exp(z)
+        return p / p.sum(axis=1, keepdims=True)
+
+    def predict_leaf_index(self, features: np.ndarray,
+                           num_used_model: int = -1) -> np.ndarray:
+        """[N, num_models] leaf indices (gbdt.cpp:510-519)."""
+        if num_used_model < 0:
+            num_used_model = len(self.models)
+        cols = []
+        for tree in self.models[:num_used_model]:
+            if tree.num_leaves == 1:
+                cols.append(np.zeros(features.shape[0], dtype=np.int32))
+            else:
+                cols.append(tree.leaf_index_by_replay(features))
+        return np.stack(cols, axis=1)
+
+    # -------------------------------------------------------------- model IO
+
+    def save_model_to_file(self, is_finish: bool, filename: str) -> None:
+        """Incremental text save (gbdt.cpp:307-348): header once, then newly
+        finished trees appended each call, withholding the trailing
+        early-stopping window until finish."""
+        if self._saved_model_size == -1:
+            self._model_file = open(filename, "w")
+            self._model_file.write("gbdt\n")
+            self._model_file.write("num_class=%d\n" % self.num_class)
+            self._model_file.write("label_index=%d\n" % self.label_idx)
+            self._model_file.write("max_feature_idx=%d\n" % self.max_feature_idx)
+            self._model_file.write("sigmoid=%s\n" % _fmt(self.sigmoid))
+            self._model_file.write("\n")
+            self._saved_model_size = 0
+        if self._model_file is None or self._model_file.closed:
+            return
+        rest = len(self.models) - self.early_stopping_round * self.num_class
+        for i in range(self._saved_model_size, rest):
+            self._model_file.write("Tree=%d\n" % i)
+            self._model_file.write(self.models[i].to_string() + "\n")
+        self._saved_model_size = max(self._saved_model_size, rest)
+        self._model_file.flush()
+        if is_finish:
+            for i in range(max(self._saved_model_size, 0), len(self.models)):
+                self._model_file.write("Tree=%d\n" % i)
+                self._model_file.write(self.models[i].to_string() + "\n")
+            self._model_file.write("\n" + self.feature_importance() + "\n")
+            self._model_file.close()
+
+    def models_from_string(self, model_str: str) -> None:
+        """GBDT::ModelsFromString (gbdt.cpp:350-441)."""
+        self.models = []
+        lines = model_str.split("\n")
+
+        def find_value(key):
+            for line in lines:
+                if key in line and "=" in line:
+                    return line.split("=", 1)[1].strip()
+            return None
+
+        num_class = find_value("num_class=")
+        if num_class is None:
+            log.fatal("Model file doesn't contain number of class")
+        self.num_class = int(num_class)
+        label_index = find_value("label_index=")
+        if label_index is None:
+            log.fatal("Model file doesn't contain label index")
+        self.label_idx = int(label_index)
+        max_feature_idx = find_value("max_feature_idx=")
+        if max_feature_idx is None:
+            log.fatal("Model file doesn't contain max_feature_idx")
+        self.max_feature_idx = int(max_feature_idx)
+        sigmoid = find_value("sigmoid=")
+        self.sigmoid = float(sigmoid) if sigmoid is not None else -1.0
+
+        i = 0
+        while i < len(lines):
+            if "Tree=" in lines[i]:
+                i += 1
+                start = i
+                while i < len(lines) and "Tree=" not in lines[i]:
+                    i += 1
+                self.models.append(Tree.from_string("\n".join(lines[start:i])))
+            else:
+                i += 1
+        log.info("%d models has been loaded" % len(self.models))
+
+    @classmethod
+    def from_model_file(cls, filename: str) -> "GBDT":
+        """Boosting::CreateBoosting from file (boosting.cpp:6-57)."""
+        with open(filename, "r") as f:
+            content = f.read()
+        first_line = content.split("\n", 1)[0].strip()
+        if first_line != "gbdt":
+            log.fatal("Unknown boosting type %s" % first_line)
+        self = cls()
+        self.models_from_string(content)
+        return self
+
+    def feature_importance(self) -> str:
+        """Split-count importances (gbdt.cpp:443-468)."""
+        importances = np.zeros(self.max_feature_idx + 1, dtype=np.int64)
+        for tree in self.models:
+            for f in tree.split_feature_real:
+                importances[f] += 1
+        names = (self.train_data.feature_names if self.train_data is not None
+                 else [f"Column_{i}" for i in range(self.max_feature_idx + 1)])
+        pairs = sorted(zip(importances, names),
+                       key=lambda p: -p[0])
+        out = ["", "feature importances:"]
+        for cnt, name in pairs:
+            out.append(f"{name}={cnt}")
+        return "\n".join(out) + "\n"
+
+
+def _serial_learner(gbdt: GBDT, bins, grad, hess, row_mask, feature_mask):
+    """Default learner: single-device serial tree growth."""
+    return grow_tree(
+        bins, grad, hess, row_mask, feature_mask, gbdt.num_bins_device,
+        num_leaves=_effective_num_leaves(gbdt.tree_config),
+        num_bins_max=gbdt.num_bins_max,
+        min_data_in_leaf=gbdt.tree_config.min_data_in_leaf,
+        min_sum_hessian_in_leaf=gbdt.tree_config.min_sum_hessian_in_leaf,
+        max_depth=gbdt.tree_config.max_depth)
+
+
+def _effective_num_leaves(tree_config) -> int:
+    """num_leaves capped by 2^(max_depth-1) (config.h:159-163)."""
+    n = tree_config.num_leaves
+    if tree_config.max_depth > 0:
+        n = min(n, 1 << (tree_config.max_depth - 1))
+    return max(n, 2)
+
+
+def _fmt(x: float) -> str:
+    return repr(float(x))

@@ -1,0 +1,67 @@
+"""Batch predictor: parse → predict → write TSV results.
+
+Re-design of /root/reference/src/application/predictor.hpp:23-228.  Per-thread
+dense row buffers become a single dense feature matrix; predictions are
+vectorized tree replays (models/tree.py) rather than per-row walks.
+Output modes match: multiclass tab-joined probabilities, leaf indices,
+sigmoid, or raw scores.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..io import parser as parser_mod
+from ..utils import log
+
+
+class Predictor:
+    def __init__(self, boosting, is_sigmoid: bool, is_predict_leaf_index: bool,
+                 num_used_model: int):
+        self.boosting = boosting
+        self.is_sigmoid = is_sigmoid
+        self.is_predict_leaf_index = is_predict_leaf_index
+        self.num_used_model = num_used_model
+        self.num_features = boosting.max_feature_idx + 1
+        self.num_class = boosting.num_class
+
+    def predict_matrix(self, features: np.ndarray) -> np.ndarray:
+        """Dense [N, num_features] → predictions (rows of the result file)."""
+        if features.shape[1] < self.num_features:
+            pad = np.zeros((features.shape[0],
+                            self.num_features - features.shape[1]))
+            features = np.concatenate([features, pad], axis=1)
+        features = features[:, :max(self.num_features, 1)]
+        if self.num_class > 1:
+            return self.boosting.predict_multiclass(features,
+                                                    self.num_used_model)
+        if self.is_predict_leaf_index:
+            return self.boosting.predict_leaf_index(features,
+                                                    self.num_used_model)
+        if self.is_sigmoid:
+            return self.boosting.predict(features, self.num_used_model)
+        return self.boosting.predict_raw(features, self.num_used_model)
+
+    def predict_file(self, data_filename: str, result_filename: str,
+                     has_header: bool) -> None:
+        """Predictor::Predict (predictor.hpp:109-197)."""
+        parser = parser_mod.create_parser(data_filename, has_header,
+                                          self.num_features,
+                                          self.boosting.label_idx)
+        lines = parser_mod.read_lines(data_filename, skip_header=has_header)
+        parsed = parser.parse(lines)
+        result = self.predict_matrix(parsed.features)
+        with open(result_filename, "w") as f:
+            if result.ndim == 1:
+                for v in result:
+                    f.write(_fmt(v) + "\n")
+            else:
+                for row in result:
+                    f.write("\t".join(_fmt(v) for v in row) + "\n")
+        log.info("Finished prediction, result saved to %s" % result_filename)
+
+
+def _fmt(v) -> str:
+    if isinstance(v, (int, np.integer)):
+        return str(int(v))
+    # std::to_string(double) prints 6 decimals
+    return "%.6f" % float(v)

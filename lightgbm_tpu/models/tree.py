@@ -1,0 +1,201 @@
+"""Tree model: fixed-shape arrays + reference-compatible text format.
+
+Re-design of /root/reference/src/io/tree.cpp and include/LightGBM/tree.h.
+The node encoding is identical (internal node k was created by the k-th
+split; leaf references are stored bitwise-complemented, ``~leaf``;
+tree.cpp:50-83), so the text format round-trips with the reference's
+``Tree::ToString`` / ``Tree(string)`` (tree.cpp:111-180).
+
+TPU-first difference: prediction is not a per-row pointer walk
+(tree.h:163-187) but a vectorized REPLAY of the split sequence — node k
+always split leaf ``~left_child[k]`` into (that leaf, leaf k+1), so applying
+the recorded splits in creation order reassigns every row's leaf id with
+[num_leaves-1] masked vector ops.  This is exactly the partition the grower
+performed, and works for both binned matrices and raw feature values.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..utils import log
+
+
+class Tree:
+    """One decision tree (flat arrays, tree.h:124-149)."""
+
+    def __init__(self, num_leaves: int,
+                 split_feature: np.ndarray,       # inner feature idx [L-1]
+                 split_feature_real: np.ndarray,  # original column idx [L-1]
+                 threshold_bin: np.ndarray,       # [L-1]
+                 threshold: np.ndarray,           # real-valued [L-1] float64
+                 split_gain: np.ndarray,          # [L-1]
+                 left_child: np.ndarray,          # [L-1] (~leaf encoding)
+                 right_child: np.ndarray,         # [L-1]
+                 leaf_parent: np.ndarray,         # [L]
+                 leaf_value: np.ndarray):         # [L] float64
+        self.num_leaves = int(num_leaves)
+        n = self.num_leaves
+        self.split_feature = np.asarray(split_feature, dtype=np.int32)[:n - 1]
+        self.split_feature_real = np.asarray(split_feature_real,
+                                             dtype=np.int32)[:n - 1]
+        self.threshold_bin = np.asarray(threshold_bin, dtype=np.int32)[:n - 1]
+        self.threshold = np.asarray(threshold, dtype=np.float64)[:n - 1]
+        self.split_gain = np.asarray(split_gain, dtype=np.float64)[:n - 1]
+        self.left_child = np.asarray(left_child, dtype=np.int32)[:n - 1]
+        self.right_child = np.asarray(right_child, dtype=np.int32)[:n - 1]
+        self.leaf_parent = np.asarray(leaf_parent, dtype=np.int32)[:n]
+        self.leaf_value = np.asarray(leaf_value, dtype=np.float64)[:n]
+
+    def shrinkage(self, rate: float) -> None:
+        """Scale leaf outputs by the learning rate (tree.h:94-98)."""
+        self.leaf_value = self.leaf_value * rate
+
+    # ----------------------------------------------------------- prediction
+
+    def leaf_index_by_replay(self, feature_values: np.ndarray) -> np.ndarray:
+        """Vectorized leaf assignment from RAW feature values.
+
+        ``feature_values`` is [N, num_total_features] in the original column
+        space; comparisons are ``value <= threshold`` → left (tree.h:177-187).
+        """
+        n_rows = feature_values.shape[0]
+        leaf = np.zeros(n_rows, dtype=np.int32)
+        split_leaf = self._split_leaf_sequence()
+        for k in range(self.num_leaves - 1):
+            col = self.split_feature_real[k]
+            go_right = feature_values[:, col] > self.threshold[k]
+            leaf = np.where((leaf == split_leaf[k]) & go_right,
+                            np.int32(k + 1), leaf)
+        return leaf
+
+    def leaf_index_by_replay_binned(self, bins: np.ndarray) -> np.ndarray:
+        """Same replay on a binned [F, N] matrix (training-data path,
+        compare ``bin <= threshold_bin``)."""
+        n_rows = bins.shape[1]
+        leaf = np.zeros(n_rows, dtype=np.int32)
+        split_leaf = self._split_leaf_sequence()
+        for k in range(self.num_leaves - 1):
+            go_right = bins[self.split_feature[k]] > self.threshold_bin[k]
+            leaf = np.where((leaf == split_leaf[k]) & go_right,
+                            np.int32(k + 1), leaf)
+        return leaf
+
+    def _split_leaf_sequence(self) -> np.ndarray:
+        """leaf id split by each node, in creation order.
+
+        Node k's right child is always the NEW leaf ``~(k+1)``
+        (tree.cpp:70-71), so the left child at creation time was the old leaf.
+        When ``left_child[k]`` is still a leaf (< 0) that's ``~left_child[k]``;
+        when it later became node m, the old leaf id is recorded in
+        ``leaf_parent``: the leaf l with ``leaf_parent[l] == k`` and
+        ``l != k+1``... reconstruction is simpler top-down: replay
+        structurally.
+        """
+        if self.num_leaves <= 1:
+            return np.zeros(0, dtype=np.int32)
+        split_leaf = np.zeros(self.num_leaves - 1, dtype=np.int32)
+        # simulate: leaves start {0}; node k splits some current leaf l into
+        # (l, k+1).  Which leaf? The one whose descendant chain reaches node
+        # k.  Walk the tree: root node 0 split leaf 0.  For node k>0, its
+        # parent node p has it as left or right child; the leaf id it split
+        # is the leaf id that traveled down that edge: left edge keeps the
+        # parent's split leaf id, right edge carries p+1.
+        parent_node = np.full(self.num_leaves - 1, -1, dtype=np.int32)
+        is_left_edge = np.zeros(self.num_leaves - 1, dtype=bool)
+        for k in range(self.num_leaves - 1):
+            lc, rc = self.left_child[k], self.right_child[k]
+            if lc >= 0:
+                parent_node[lc] = k
+                is_left_edge[lc] = True
+            if rc >= 0:
+                parent_node[rc] = k
+                is_left_edge[rc] = False
+        for k in range(self.num_leaves - 1):
+            if k == 0:
+                split_leaf[k] = 0
+            else:
+                p = parent_node[k]
+                split_leaf[k] = split_leaf[p] if is_left_edge[k] else p + 1
+        return split_leaf
+
+    def predict(self, feature_values: np.ndarray) -> np.ndarray:
+        """Batch raw-feature prediction → leaf outputs."""
+        if self.num_leaves == 1:
+            return np.full(feature_values.shape[0], self.leaf_value[0])
+        return self.leaf_value[self.leaf_index_by_replay(feature_values)]
+
+    def predict_binned(self, bins: np.ndarray) -> np.ndarray:
+        if self.num_leaves == 1:
+            return np.full(bins.shape[1], self.leaf_value[0])
+        return self.leaf_value[self.leaf_index_by_replay_binned(bins)]
+
+    # ------------------------------------------------------------ text form
+
+    def to_string(self) -> str:
+        """Tree::ToString (tree.cpp:111-130) — same keys, same order."""
+        n = self.num_leaves
+        lines = [
+            f"num_leaves={n}",
+            "split_feature=" + " ".join(str(int(x)) for x in self.split_feature_real),
+            "split_gain=" + " ".join(_num_to_str(x) for x in self.split_gain),
+            "threshold=" + " ".join(_num_to_str(x) for x in self.threshold),
+            "left_child=" + " ".join(str(int(x)) for x in self.left_child),
+            "right_child=" + " ".join(str(int(x)) for x in self.right_child),
+            "leaf_parent=" + " ".join(str(int(x)) for x in self.leaf_parent),
+            "leaf_value=" + " ".join(_num_to_str(x) for x in self.leaf_value),
+            "",
+        ]
+        return "\n".join(lines) + "\n"
+
+    @classmethod
+    def from_string(cls, text: str) -> "Tree":
+        """Tree::Tree(const std::string&) (tree.cpp:132-180)."""
+        key_vals = {}
+        for line in text.split("\n"):
+            if "=" in line:
+                key, val = line.split("=", 1)
+                key, val = key.strip(), val.strip()
+                if key and val:
+                    key_vals[key] = val
+        required = ("num_leaves", "split_feature", "split_gain", "threshold",
+                    "left_child", "right_child", "leaf_parent", "leaf_value")
+        for key in required:
+            if key not in key_vals:
+                log.fatal("tree model string format error")
+        n = int(key_vals["num_leaves"])
+
+        def ints(key, cnt):
+            vals = [int(x) for x in key_vals[key].split()] if cnt > 0 else []
+            return np.array(vals[:cnt], dtype=np.int32)
+
+        def floats(key, cnt):
+            vals = [float(x) for x in key_vals[key].split()] if cnt > 0 else []
+            return np.array(vals[:cnt], dtype=np.float64)
+
+        split_feature_real = ints("split_feature", n - 1)
+        return cls(
+            num_leaves=n,
+            split_feature=split_feature_real,  # inner == real after load
+            split_feature_real=split_feature_real,
+            threshold_bin=np.zeros(max(n - 1, 0), dtype=np.int32),
+            threshold=floats("threshold", n - 1),
+            split_gain=floats("split_gain", n - 1),
+            left_child=ints("left_child", n - 1),
+            right_child=ints("right_child", n - 1),
+            leaf_parent=ints("leaf_parent", n),
+            leaf_value=floats("leaf_value", n),
+        )
+
+
+def _num_to_str(x) -> str:
+    """Number formatting compatible with C++ ostream double output."""
+    x = float(x)
+    if x == float("inf"):
+        return "inf"
+    if x == float("-inf"):
+        return "-inf"
+    if x != x:
+        return "nan"
+    return repr(x)

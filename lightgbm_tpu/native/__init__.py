@@ -1,0 +1,5 @@
+"""Native C++ acceleration library (text parsing, binning kernels).
+
+Built from native/src/*.cpp into a shared library loaded via ctypes; every
+entry point has a NumPy fallback so the framework works without the build.
+"""

@@ -1,0 +1,64 @@
+"""ctypes loader for the native C++ helper library.
+
+The reference is a pure C++ program; in this framework the device compute is
+XLA and the host runtime keeps native C++ for the text-parsing hot path
+(utils/text_reader.h + parser.hpp equivalents).  Built by
+``lightgbm_tpu/native/build.sh`` (g++ -O3 -fopenmp -shared).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import List, Optional
+
+import numpy as np
+
+_LIB = None
+_TRIED = False
+
+
+def _lib_path() -> str:
+    return os.path.join(os.path.dirname(__file__), "liblgbm_native.so")
+
+
+def _load():
+    global _LIB, _TRIED
+    if not _TRIED:
+        _TRIED = True
+        path = _lib_path()
+        if os.path.exists(path):
+            try:
+                lib = ctypes.CDLL(path)
+                lib.parse_delimited.restype = ctypes.c_int
+                lib.parse_delimited.argtypes = [
+                    ctypes.c_char_p, ctypes.c_longlong, ctypes.c_char,
+                    ctypes.c_longlong, ctypes.c_longlong,
+                    ctypes.POINTER(ctypes.c_double),
+                ]
+                _LIB = lib
+            except OSError:
+                _LIB = None
+    return _LIB
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def parse_delimited(lines: List[str], delimiter: str) -> Optional[np.ndarray]:
+    """Parse uniform delimited lines into a float64 matrix, or None to make
+    the caller fall back to the Python path."""
+    lib = _load()
+    if lib is None or not lines:
+        return None
+    ncols = lines[0].count(delimiter) + 1
+    nrows = len(lines)
+    blob = ("\n".join(lines) + "\n").encode()
+    out = np.empty((nrows, ncols), dtype=np.float64)
+    rc = lib.parse_delimited(
+        blob, len(blob), delimiter.encode()[0] if delimiter != "\t" else 9,
+        nrows, ncols,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+    if rc != 0:
+        return None
+    return out

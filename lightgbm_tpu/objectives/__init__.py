@@ -1,0 +1,27 @@
+"""Objective functions: gradients/hessians on device.
+
+Re-design of /root/reference/src/objective/ as pure jnp element-wise (or
+per-query, for lambdarank) transforms.  Factory mirrors
+objective_function.cpp:9-20.  Gradients/hessians are float32 (score_t,
+meta.h:15).
+"""
+from __future__ import annotations
+
+from ..utils import log
+from .regression import RegressionL2Loss
+from .binary import BinaryLogloss
+from .multiclass import MulticlassLogloss
+from .rank import LambdarankNDCG
+
+
+def create_objective(objective_type: str, config):
+    """CreateObjectiveFunction (objective_function.cpp:9-20)."""
+    if objective_type == "regression":
+        return RegressionL2Loss(config)
+    if objective_type == "binary":
+        return BinaryLogloss(config)
+    if objective_type == "lambdarank":
+        return LambdarankNDCG(config)
+    if objective_type == "multiclass":
+        return MulticlassLogloss(config)
+    log.fatal("Unknown objective type name: %s" % objective_type)

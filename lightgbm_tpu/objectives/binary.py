@@ -1,0 +1,58 @@
+"""Binary logloss objective (/root/reference/src/objective/binary_objective.hpp:13-102)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..utils import log
+
+
+class BinaryLogloss:
+    def __init__(self, config):
+        self.is_unbalance = config.is_unbalance
+        self._sigmoid = float(config.sigmoid)
+        if self._sigmoid <= 0.0:
+            log.fatal("Sigmoid parameter %f :should greater than zero"
+                      % self._sigmoid)
+        self.weights = None
+
+    def init(self, metadata, num_data: int) -> None:
+        label = np.asarray(metadata.label)
+        cnt_positive = int((label == 1).sum())
+        cnt_negative = num_data - cnt_positive
+        log.info("Number of postive:%d,  number of negative:%d"
+                 % (cnt_positive, cnt_negative))
+        if cnt_positive == 0 or cnt_negative == 0:
+            log.fatal("Input training data only contains one class")
+        # labels → {−1, +1}; unbalance reweights negatives by pos/neg
+        # (binary_objective.hpp:42-52)
+        self.label_sign = jnp.asarray(np.where(label == 1, 1.0, -1.0),
+                                      jnp.float32)
+        neg_weight = (cnt_positive / cnt_negative if self.is_unbalance else 1.0)
+        self.label_weight = jnp.asarray(
+            np.where(label == 1, 1.0, neg_weight), jnp.float32)
+        if metadata.weights is not None:
+            self.weights = jnp.asarray(metadata.weights, jnp.float32)
+
+    def get_gradients(self, score: jax.Array):
+        """response = −2·l·σ/(1+exp(2·l·σ·s)); hess = |r|(2σ−|r|)
+        (binary_objective.hpp:55-81)."""
+        sig = jnp.float32(self._sigmoid)
+        ls = self.label_sign
+        response = -2.0 * ls * sig / (1.0 + jnp.exp(2.0 * ls * sig * score))
+        abs_response = jnp.abs(response)
+        grad = response * self.label_weight
+        hess = abs_response * (2.0 * sig - abs_response) * self.label_weight
+        if self.weights is not None:
+            grad = grad * self.weights
+            hess = hess * self.weights
+        return grad, hess
+
+    @property
+    def sigmoid(self) -> float:
+        return self._sigmoid
+
+    @property
+    def num_class(self) -> int:
+        return 1

@@ -1,0 +1,43 @@
+"""Multiclass softmax objective (/root/reference/src/objective/multiclass_objective.hpp:13-92)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..utils import log
+
+
+class MulticlassLogloss:
+    def __init__(self, config):
+        self._num_class = int(config.num_class)
+        self.weights = None
+
+    def init(self, metadata, num_data: int) -> None:
+        label = np.asarray(metadata.label).astype(np.int32)
+        if ((label < 0) | (label >= self._num_class)).any():
+            log.fatal("Label must be in [0, %d)" % self._num_class)
+        self.label_int = jnp.asarray(label)
+        self.onehot = jnp.asarray(
+            np.eye(self._num_class, dtype=np.float32)[label])  # [N, K]
+        if metadata.weights is not None:
+            self.weights = jnp.asarray(metadata.weights, jnp.float32)
+
+    def get_gradients(self, score: jax.Array):
+        """score layout [K, N]; softmax per row; grad = p − 1[y=k],
+        hess = 2p(1−p) (multiclass_objective.hpp:37-75)."""
+        p = jax.nn.softmax(score.astype(jnp.float32), axis=0)  # [K, N]
+        grad = p - self.onehot.T
+        hess = 2.0 * p * (1.0 - p)
+        if self.weights is not None:
+            grad = grad * self.weights[None, :]
+            hess = hess * self.weights[None, :]
+        return grad, hess
+
+    @property
+    def sigmoid(self) -> float:
+        return -1.0
+
+    @property
+    def num_class(self) -> int:
+        return self._num_class

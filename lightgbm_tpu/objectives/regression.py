@@ -1,0 +1,33 @@
+"""L2 regression objective (/root/reference/src/objective/regression_objective.hpp:10-53)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class RegressionL2Loss:
+    def __init__(self, config):
+        self.weights = None
+
+    def init(self, metadata, num_data: int) -> None:
+        self.label = jnp.asarray(metadata.label, jnp.float32)
+        if metadata.weights is not None:
+            self.weights = jnp.asarray(metadata.weights, jnp.float32)
+
+    def get_gradients(self, score: jax.Array):
+        """grad = score − label, hess = 1 (×weight)
+        (regression_objective.hpp:24-39)."""
+        grad = score.astype(jnp.float32) - self.label
+        hess = jnp.ones_like(grad)
+        if self.weights is not None:
+            grad = grad * self.weights
+            hess = hess * self.weights
+        return grad, hess
+
+    @property
+    def sigmoid(self) -> float:
+        return -1.0
+
+    @property
+    def num_class(self) -> int:
+        return 1

@@ -1,0 +1,3 @@
+"""Device ops: histogram build, split search, scoring."""
+from .histogram import build_histogram
+from .split import find_best_split, SplitResult

@@ -1,0 +1,97 @@
+"""Vectorized tree application (score updates / prediction on binned data).
+
+Replaces the reference's per-row pointer walks (tree.h:163-175,
+tree.cpp:85-109) with a split-sequence REPLAY: node k split leaf
+``split_leaf[k]`` into (itself, leaf k+1), so applying the recorded splits in
+creation order reassigns every row's leaf id using [num_leaves-1] masked
+vector steps — each step is one dynamic-sliced bin row gather + compare,
+which is bandwidth-bound and TPU-friendly.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("max_nodes",))
+def leaf_ids_by_replay(bins: jax.Array, split_feature: jax.Array,
+                       threshold_bin: jax.Array, split_leaf: jax.Array,
+                       num_nodes: jax.Array, *, max_nodes: int) -> jax.Array:
+    """Assign each row (column of ``bins``) to a leaf.
+
+    Parameters
+    ----------
+    bins : [F, N] bin matrix
+    split_feature, threshold_bin, split_leaf : [max_nodes] per-node records
+    num_nodes : actual node count (num_leaves - 1)
+    """
+    N = bins.shape[1]
+    leaf = jnp.zeros((N,), jnp.int32)
+
+    def body(k, leaf):
+        active = k < num_nodes
+        fbin = jax.lax.dynamic_index_in_dim(
+            bins, split_feature[k], axis=0, keepdims=False).astype(jnp.int32)
+        go_right = fbin > threshold_bin[k]
+        new_leaf = jnp.where((leaf == split_leaf[k]) & go_right, k + 1, leaf)
+        return jnp.where(active, new_leaf, leaf)
+
+    return jax.lax.fori_loop(0, max_nodes, body, leaf)
+
+
+def split_leaf_sequence(left_child: jax.Array, right_child: jax.Array,
+                        num_leaves_max: int, num_nodes=None):
+    """Compute, per node in creation order, the leaf id it split.
+
+    Node k's right child is always the new leaf ``~(k+1)`` (tree.cpp:70-71);
+    walking parent edges top-down: the root split leaf 0; a node reached via
+    its parent's LEFT edge split the same leaf id as its parent, via the
+    RIGHT edge it split leaf ``parent+1``.  Pure jnp so it can run under jit.
+    """
+    L1 = num_leaves_max - 1
+    parent = jnp.full((L1,), -1, jnp.int32)
+    is_left = jnp.zeros((L1,), bool)
+
+    def record(k, carry):
+        parent, is_left = carry
+        active = True if num_nodes is None else (k < num_nodes)
+        # padded node slots carry zeros; mask them so they cannot touch
+        # real entries
+        lc = jnp.where(active, left_child[k], -1)
+        rc = jnp.where(active, right_child[k], -1)
+        parent = jnp.where(lc >= 0, parent.at[jnp.maximum(lc, 0)].set(k), parent)
+        is_left = jnp.where(lc >= 0, is_left.at[jnp.maximum(lc, 0)].set(True),
+                            is_left)
+        parent = jnp.where(rc >= 0, parent.at[jnp.maximum(rc, 0)].set(k), parent)
+        is_left = jnp.where(rc >= 0, is_left.at[jnp.maximum(rc, 0)].set(False),
+                            is_left)
+        return parent, is_left
+
+    parent, is_left = jax.lax.fori_loop(0, L1, record, (parent, is_left))
+
+    split_leaf = jnp.zeros((L1,), jnp.int32)
+
+    def fill(k, split_leaf):
+        p = parent[k]
+        val = jnp.where(k == 0, 0,
+                        jnp.where(is_left[k], split_leaf[jnp.maximum(p, 0)],
+                                  p + 1))
+        return split_leaf.at[k].set(val)
+
+    return jax.lax.fori_loop(0, L1, fill, split_leaf)
+
+
+@functools.partial(jax.jit, static_argnames=("max_nodes",))
+def add_tree_score(bins: jax.Array, score: jax.Array,
+                   split_feature: jax.Array, threshold_bin: jax.Array,
+                   left_child: jax.Array, right_child: jax.Array,
+                   leaf_value: jax.Array, num_leaves: jax.Array,
+                   *, max_nodes: int) -> jax.Array:
+    """score += tree(bins rows) — Tree::AddPredictionToScore equivalent."""
+    split_leaf = split_leaf_sequence(left_child, right_child, max_nodes + 1,
+                                     num_nodes=num_leaves - 1)
+    leaf = leaf_ids_by_replay(bins, split_feature, threshold_bin, split_leaf,
+                              num_leaves - 1, max_nodes=max_nodes)
+    return score + leaf_value[leaf].astype(score.dtype)

@@ -1,0 +1,21 @@
+"""Distributed tree learning over a jax.sharding.Mesh.
+
+Replaces the reference's Network layer (/root/reference/src/network/ —
+Bruck allgather, recursive-halving reduce-scatter over sockets/MPI) with XLA
+collectives inside ``shard_map``:
+
+- data-parallel  (data_parallel_tree_learner.cpp)  → rows sharded over the
+  ``data`` mesh axis, histograms ``psum``/``psum_scatter``'d, split decisions
+  replicated → bit-identical trees on every shard.
+- feature-parallel (feature_parallel_tree_learner.cpp) → per-shard feature
+  ownership masks + packed argmax allreduce of SplitInfo.
+- distributed bin finding (dataset.cpp:353-415) → feature-sliced FindBin +
+  allgather.
+
+Multi-host bootstrap (socket mlist / MPI ranks, linkers_socket.cpp) maps to
+``jax.distributed.initialize`` + the global device mesh.
+"""
+from __future__ import annotations
+
+from .mesh import (get_mesh, get_rank, get_num_machines, sync_up_by_min)
+from .learners import create_parallel_learner, distributed_bin_finder

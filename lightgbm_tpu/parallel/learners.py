@@ -1,0 +1,226 @@
+"""Parallel tree learners over the device mesh.
+
+Re-designs of /root/reference/src/treelearner/{data,feature}_parallel_tree_learner.cpp
+with XLA collectives inside ``shard_map``:
+
+- **data-parallel** (rows sharded over the ``data`` axis): every shard builds
+  local histograms, a ``psum`` produces the identical global histograms on
+  all shards, and the replicated split search yields bit-identical trees —
+  the reference's invariant (data_parallel_tree_learner.cpp:237-243: every
+  worker ends each split with the identical global best split) enforced by
+  construction.  The reference's ReduceScatter+owned-feature-search+Allgather
+  schedule (lines 135-235) is a bandwidth optimization of the same reduction;
+  psum is its all-to-all equivalent on ICI.
+- **feature-parallel** (feature ownership sharded over the ``feature`` axis,
+  rows replicated): each shard histograms and searches ONLY its owned
+  feature slice, then a packed SplitInfo argmax-allreduce picks the global
+  winner (feature_parallel_tree_learner.cpp:46-79, SplitInfo::MaxReducer
+  split_info.hpp:56-72: max gain, ties → smaller feature index); the split
+  itself is applied locally on the replicated bin matrix.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..models.grower import TreeArrays, grow_tree_impl
+from ..models.gbdt import _effective_num_leaves
+from ..ops.split import SplitResult, find_best_split
+from ..io.binning import BinMapper
+from ..utils import log
+from .mesh import DATA_AXIS, FEATURE_AXIS, get_mesh
+
+try:
+    from jax import shard_map as _shard_map  # JAX >= 0.7 name
+
+    def shard_map(f, mesh, in_specs, out_specs, check_rep=False):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=check_rep)
+except ImportError:  # pragma: no cover - older JAX
+    from jax.experimental.shard_map import shard_map as _shard_map_old
+
+    def shard_map(f, mesh, in_specs, out_specs, check_rep=False):
+        return _shard_map_old(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=check_rep)
+
+
+def allreduce_best_split(res: SplitResult, axis_name: str) -> SplitResult:
+    """SplitInfo::MaxReducer as an argmax allreduce (split_info.hpp:56-104):
+    max gain wins; ties broken by the smaller (global) feature index."""
+    stacked = jax.tree.map(lambda x: jax.lax.all_gather(x, axis_name), res)
+    gain = stacked.gain
+    max_gain = jnp.max(gain)
+    is_max = (gain == max_gain) & jnp.isfinite(max_gain)
+    feat_key = jnp.where(is_max, stacked.feature, jnp.int32(1 << 30))
+    pick = jnp.argmin(feat_key)
+    return jax.tree.map(lambda x: x[pick], stacked)
+
+
+def _tree_out_specs(data_axis=None):
+    """TreeArrays out_specs: everything replicated except the row-sharded
+    leaf-id vector."""
+    return TreeArrays(
+        num_leaves=P(), split_feature=P(), threshold_bin=P(), split_gain=P(),
+        left_child=P(), right_child=P(), leaf_parent=P(), leaf_value=P(),
+        leaf_count=P(), leaf_ids=P(data_axis))
+
+
+def create_parallel_learner(config) -> Callable:
+    """TreeLearner::CreateTreeLearner (tree_learner.cpp:8-17) for the
+    parallel variants; returns a callable with the GBDT learner contract."""
+    kind = config.boosting_config.tree_learner
+    if kind == "data":
+        return DataParallelLearner(config)
+    if kind == "feature":
+        return FeatureParallelLearner(config)
+    log.fatal("Tree learner type error")
+
+
+class _ParallelLearnerBase:
+    def __init__(self, config):
+        self.config = config
+        self.tree_config = config.boosting_config.tree_config
+        self._jitted = None
+
+    def _grow_kwargs(self, gbdt):
+        return dict(
+            num_leaves=_effective_num_leaves(self.tree_config),
+            num_bins_max=gbdt.num_bins_max,
+            min_data_in_leaf=self.tree_config.min_data_in_leaf,
+            min_sum_hessian_in_leaf=self.tree_config.min_sum_hessian_in_leaf,
+            max_depth=self.tree_config.max_depth)
+
+
+class DataParallelLearner(_ParallelLearnerBase):
+    """Rows sharded; histograms psum'd (data_parallel_tree_learner.cpp)."""
+
+    def __call__(self, gbdt, bins, grad, hess, row_mask, feature_mask):
+        mesh = get_mesh(self.config.network_config.num_machines, DATA_AXIS)
+        num_shards = mesh.shape[DATA_AXIS]
+        F, N = bins.shape
+        pad = (-N) % num_shards
+        if pad:
+            bins = jnp.pad(bins, ((0, 0), (0, pad)))
+            grad = jnp.pad(grad, (0, pad))
+            hess = jnp.pad(hess, (0, pad))
+            row_mask = jnp.pad(row_mask, (0, pad))
+
+        if self._jitted is None:
+            kwargs = self._grow_kwargs(gbdt)
+
+            def shard_fn(bins_s, grad_s, hess_s, mask_s, fmask, nbins):
+                return grow_tree_impl(
+                    bins_s, grad_s, hess_s, mask_s, fmask, nbins,
+                    hist_reduce=lambda h: jax.lax.psum(h, DATA_AXIS),
+                    **kwargs)
+
+            self._jitted = jax.jit(shard_map(
+                shard_fn, mesh=mesh,
+                in_specs=(P(None, DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+                          P(DATA_AXIS), P(), P()),
+                out_specs=_tree_out_specs(DATA_AXIS)))
+
+        tree = self._jitted(bins, grad, hess, row_mask, feature_mask,
+                            gbdt.num_bins_device)
+        if pad:
+            tree = tree._replace(leaf_ids=tree.leaf_ids[:N])
+        return tree
+
+
+class FeatureParallelLearner(_ParallelLearnerBase):
+    """Feature ownership sharded, data replicated
+    (feature_parallel_tree_learner.cpp).  The reference re-balances feature
+    ownership by bin count each tree (lines 27-44); here ownership is a
+    static contiguous slice of the (randomly ordered) feature space — the
+    result is invariant to ownership, only load balance differs."""
+
+    def __call__(self, gbdt, bins, grad, hess, row_mask, feature_mask):
+        mesh = get_mesh(self.config.network_config.num_machines, FEATURE_AXIS)
+        num_shards = mesh.shape[FEATURE_AXIS]
+        F, N = bins.shape
+        Fs = -(-F // num_shards)  # owned features per shard
+        fpad = Fs * num_shards - F
+        if fpad:
+            # pad the feature axis so every shard's dynamic_slice is aligned
+            # with its nbins/fmask slices (padded features are masked out and
+            # can never win the split allreduce)
+            bins = jnp.pad(bins, ((0, fpad), (0, 0)))
+
+        if self._jitted is None:
+            kwargs = self._grow_kwargs(gbdt)
+
+            def shard_fn(bins_full, grad_s, hess_s, mask_s, fmask_pad,
+                         nbins_pad):
+                rank = jax.lax.axis_index(FEATURE_AXIS)
+                offset = rank * Fs
+                bins_own = jax.lax.dynamic_slice(
+                    bins_full, (offset, jnp.int32(0)),
+                    (Fs, bins_full.shape[1]))
+                nbins_own = jax.lax.dynamic_slice(nbins_pad, (offset,), (Fs,))
+                fmask_own = jax.lax.dynamic_slice(fmask_pad, (offset,), (Fs,))
+
+                def finder(hist, sg, sh, cnt, nb, fm, mind, minh):
+                    local = find_best_split(hist, sg, sh, cnt, nb, fm,
+                                            mind, minh)
+                    local = local._replace(
+                        feature=(local.feature + offset).astype(jnp.int32))
+                    return allreduce_best_split(local, FEATURE_AXIS)
+
+                return grow_tree_impl(
+                    bins_own, grad_s, hess_s, mask_s, fmask_own, nbins_own,
+                    split_finder=finder, partition_bins=bins_full, **kwargs)
+
+            self._jitted = jax.jit(shard_map(
+                shard_fn, mesh=mesh,
+                in_specs=(P(), P(), P(), P(), P(), P()),
+                out_specs=_tree_out_specs(None)))
+
+        nbins_pad = jnp.pad(gbdt.num_bins_device, (0, fpad),
+                            constant_values=1)
+        fmask_pad = jnp.pad(feature_mask, (0, fpad))
+        tree = self._jitted(bins, grad, hess, row_mask, fmask_pad, nbins_pad)
+        return tree
+
+
+def distributed_bin_finder(config):
+    """Distributed bin finding (dataset.cpp:353-415).
+
+    Each process computes BinMappers for a contiguous feature slice from the
+    (identical) global sample and allgathers the results.  Single-process
+    runs return None → local bin finding (identical output, the distribution
+    is purely a speed optimization since every worker holds the same
+    sample)."""
+    if jax.process_count() == 1:
+        return None
+
+    def finder(sample: np.ndarray, max_bin: int):
+        from jax.experimental import multihost_utils
+        nproc = jax.process_count()
+        rank = jax.process_index()
+        F = sample.shape[1]
+        step = -(-F // nproc)
+        lo, hi = rank * step, min((rank + 1) * step, F)
+        blobs = []
+        for j in range(lo, hi):
+            mapper = BinMapper()
+            mapper.find_bin(sample[:, j], max_bin)
+            blobs.append(mapper.to_bytes())
+        # fixed-size padding like BinMapper::SizeForSpecificBin
+        # (dataset.cpp:371-376) so the gather is a dense array
+        max_len = 16 + 8 * (max_bin + 1)
+        buf = np.zeros((step, max_len), dtype=np.uint8)
+        for i, blob in enumerate(blobs):
+            buf[i, :len(blob)] = np.frombuffer(blob, dtype=np.uint8)
+        gathered = multihost_utils.process_allgather(buf)  # [nproc, step, max_len]
+        mappers = []
+        for j in range(F):
+            r, i = divmod(j, step)
+            mappers.append(BinMapper.from_bytes(gathered[r, i].tobytes()))
+        return mappers
+
+    return finder

@@ -1,0 +1,75 @@
+"""Device mesh construction and multi-host bootstrap.
+
+Replaces the reference's Linkers bootstrap
+(/root/reference/src/network/linkers_socket.cpp:20-110: machine-list parse,
+rank inference, TCP mesh) with jax.distributed + a 1-D
+``jax.sharding.Mesh``.  A "machine" in the reference maps to a mesh slot
+(one TPU device — or one device per host in multi-host runs); collective
+traffic rides ICI/DCN via XLA instead of raw sockets.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+from ..utils import log
+
+DATA_AXIS = "data"
+FEATURE_AXIS = "feature"
+
+_mesh: Optional[Mesh] = None
+
+
+def init_distributed(config=None) -> None:
+    """Multi-host bootstrap (linkers_socket.cpp equivalent).
+
+    Uses jax.distributed when coordinator env vars are present; single-host
+    multi-device needs no bootstrap.
+    """
+    coordinator = os.environ.get("LGBM_TPU_COORDINATOR")
+    if coordinator and jax.process_count() == 1:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=int(os.environ.get("LGBM_TPU_NUM_PROCS", "1")),
+            process_id=int(os.environ.get("LGBM_TPU_PROC_ID", "0")))
+
+
+def get_mesh(num_machines: Optional[int] = None,
+             axis_name: str = DATA_AXIS) -> Mesh:
+    """1-D mesh over the first ``num_machines`` devices."""
+    global _mesh
+    devices = jax.devices()
+    if num_machines is None or num_machines <= 0:
+        num_machines = len(devices)
+    if num_machines > len(devices):
+        log.warning(
+            "num_machines=%d exceeds available devices (%d); shrinking "
+            "world size to match (linkers_socket.cpp:106-109 behavior)"
+            % (num_machines, len(devices)))
+        num_machines = len(devices)
+    mesh = Mesh(np.array(devices[:num_machines]), (axis_name,))
+    _mesh = mesh
+    return mesh
+
+
+def get_rank() -> int:
+    """Process rank for host-side data sharding (Network::rank)."""
+    return jax.process_index()
+
+
+def get_num_machines() -> int:
+    return jax.process_count()
+
+
+def sync_up_by_min(value):
+    """GlobalSyncUpByMin (application.cpp:275-302): align seeds/fractions to
+    the global minimum across processes for deterministic distributed runs."""
+    if jax.process_count() == 1:
+        return value
+    from jax.experimental import multihost_utils
+    gathered = multihost_utils.process_allgather(np.asarray(value))
+    return type(value)(np.min(gathered))

@@ -1,0 +1,72 @@
+"""Logging for lightgbm_tpu.
+
+TPU-native re-design of the reference's static logger
+(/root/reference/include/LightGBM/utils/log.h:12-90): same levels and
+``[LightGBM] [Level]`` stdout prefix so CLI output is familiar, but built on a
+plain Python module instead of a C++ static class.  ``Fatal`` raises instead of
+calling ``exit(1)`` so library users get a catchable exception; the CLI
+converts it to a non-zero exit.
+"""
+from __future__ import annotations
+
+import sys
+
+# Levels mirror log.h: Fatal=-1, Error=0, Warning=1, Info=2, Debug=3.
+FATAL = -1
+ERROR = 0
+WARNING = 1
+INFO = 2
+DEBUG = 3
+
+_level = INFO
+
+
+class LightGBMError(RuntimeError):
+    """Raised where the reference would Log::Fatal + exit(1)."""
+
+
+def set_level(level: int) -> None:
+    global _level
+    _level = level
+
+
+def get_level() -> int:
+    return _level
+
+
+def _write(tag: str, msg: str) -> None:
+    sys.stdout.write(f"[LightGBM] [{tag}] {msg}\n")
+    sys.stdout.flush()
+
+
+def debug(msg: str, *args) -> None:
+    if _level >= DEBUG:
+        _write("Debug", msg % args if args else msg)
+
+
+def info(msg: str, *args) -> None:
+    if _level >= INFO:
+        _write("Info", msg % args if args else msg)
+
+
+def warning(msg: str, *args) -> None:
+    if _level >= WARNING:
+        _write("Warning", msg % args if args else msg)
+
+
+def error(msg: str, *args) -> None:
+    if _level >= ERROR:
+        _write("Error", msg % args if args else msg)
+
+
+def fatal(msg: str, *args) -> None:
+    """Equivalent of Log::Fatal (log.h:63-72) minus the process kill."""
+    text = msg % args if args else msg
+    _write("Fatal", text)
+    raise LightGBMError(text)
+
+
+def check(condition: bool, msg: str = "check failed") -> None:
+    """CHECK macro equivalent (log.h:12-21)."""
+    if not condition:
+        fatal(msg)

@@ -1,0 +1,57 @@
+"""Test configuration: force an 8-device virtual CPU platform so sharding
+and parallel-learner tests run without TPU hardware (SURVEY.md §4).
+
+Note: the environment's sitecustomize imports jax before pytest starts, so
+plain env vars are too late — use jax.config.update, which takes effect any
+time before backend initialization.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+REFERENCE_EXAMPLES = "/root/reference/examples"
+
+
+@pytest.fixture(scope="session")
+def binary_example_paths():
+    base = os.path.join(REFERENCE_EXAMPLES, "binary_classification")
+    if not os.path.isdir(base):
+        pytest.skip("reference examples not available")
+    return {
+        "train": os.path.join(base, "binary.train"),
+        "test": os.path.join(base, "binary.test"),
+        "train_conf": os.path.join(base, "train.conf"),
+        "predict_conf": os.path.join(base, "predict.conf"),
+    }
+
+
+@pytest.fixture()
+def synthetic_binary():
+    """Small deterministic binary-classification dataset."""
+    rng = np.random.RandomState(7)
+    n, f = 2000, 12
+    x = rng.randn(n, f)
+    logits = x[:, 0] * 1.5 - x[:, 1] + 0.5 * x[:, 2] * x[:, 3]
+    y = (logits + rng.randn(n) * 0.5 > 0).astype(np.float32)
+    return x, y
+
+
+@pytest.fixture()
+def synthetic_regression():
+    rng = np.random.RandomState(11)
+    n, f = 1500, 8
+    x = rng.randn(n, f)
+    y = (2.0 * x[:, 0] - x[:, 1] + 0.3 * x[:, 2] ** 2
+         + rng.randn(n) * 0.1).astype(np.float32)
+    return x, y

@@ -1,0 +1,72 @@
+"""BinMapper unit tests against hand-computed values (SURVEY §4 test plan b)."""
+import numpy as np
+import pytest
+
+from lightgbm_tpu.io.binning import BinMapper
+
+
+def test_distinct_values_path():
+    # num distinct <= max_bin: boundaries are midpoints, last +inf
+    values = np.array([1.0, 1.0, 2.0, 3.0, 3.0, 3.0])
+    m = BinMapper()
+    m.find_bin(values, max_bin=8)
+    assert m.num_bin == 3
+    np.testing.assert_allclose(m.bin_upper_bound[:2], [1.5, 2.5])
+    assert np.isinf(m.bin_upper_bound[2])
+    assert not m.is_trivial
+    # sparse rate = share of bin 0 (value 1.0 appears twice in 6 samples)
+    assert m.sparse_rate == pytest.approx(2 / 6)
+
+
+def test_value_to_bin_boundaries():
+    m = BinMapper()
+    m.find_bin(np.array([0.0, 1.0, 2.0]), max_bin=8)
+    # boundaries [0.5, 1.5, inf]; value <= upper → that bin
+    assert m.value_to_bin(0.0) == 0
+    assert m.value_to_bin(0.5) == 0
+    assert m.value_to_bin(0.50001) == 1
+    assert m.value_to_bin(1.5) == 1
+    assert m.value_to_bin(100.0) == 2
+    np.testing.assert_array_equal(
+        m.value_to_bin(np.array([0.0, 0.6, 3.0])), [0, 1, 2])
+
+
+def test_trivial_feature():
+    m = BinMapper()
+    m.find_bin(np.full(100, 3.14), max_bin=8)
+    assert m.num_bin == 1
+    assert m.is_trivial
+
+
+def test_hybrid_path_dedicated_bins():
+    # one dominant value gets a dedicated bin when count > mean_bin_size
+    values = np.concatenate([np.zeros(900), np.arange(1, 101)])
+    m = BinMapper()
+    m.find_bin(values, max_bin=10)
+    assert m.num_bin <= 10
+    assert m.num_bin > 1
+    # zero must map to its own dedicated bin: nothing else shares it
+    zero_bin = int(m.value_to_bin(0.0))
+    others = m.value_to_bin(np.arange(1, 101).astype(float))
+    assert not np.any(others == zero_bin)
+
+
+def test_bins_are_monotonic():
+    rng = np.random.RandomState(3)
+    values = rng.randn(5000)
+    m = BinMapper()
+    m.find_bin(values, max_bin=32)
+    bounds = m.bin_upper_bound
+    assert np.all(np.diff(bounds[:-1]) > 0)
+    # every value maps into [0, num_bin)
+    bins = m.value_to_bin(values)
+    assert bins.min() >= 0 and bins.max() < m.num_bin
+
+
+def test_roundtrip_serialization():
+    m = BinMapper()
+    m.find_bin(np.random.RandomState(0).randn(1000), max_bin=16)
+    m2 = BinMapper.from_bytes(m.to_bytes())
+    assert m2.num_bin == m.num_bin
+    assert m2.is_trivial == m.is_trivial
+    np.testing.assert_allclose(m2.bin_upper_bound, m.bin_upper_bound)
