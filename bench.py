@@ -1,0 +1,92 @@
+"""Benchmark driver: boosting iters/sec on a Higgs-like synthetic dataset.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Baseline: the reference LightGBM binary (compiled from /root/reference with
+-O2, socket variant) measured on the SAME synthetic dataset and config
+(1M rows × 28 features, num_leaves=255, max_bin=255, binary objective) on
+the dev host CPU (single core): 0.433 s/iter → 2.31 iters/sec
+(BASELINE.md prescribes measuring the reference locally since the repo
+publishes no numbers).
+
+Usage: python bench.py [--rows N] [--leaves L] [--iters K]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+REFERENCE_CPU_ITERS_PER_SEC = 2.31  # see module docstring
+
+
+def make_data(rows: int, features: int, seed: int = 42):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(rows, features).astype(np.float32)
+    w = rng.randn(features) / np.sqrt(features)
+    logits = x @ w + 0.5 * np.sin(x[:, 0] * 2) + 0.3 * x[:, 1] * x[:, 2]
+    y = (logits + rng.randn(rows) * 0.5 > 0).astype(np.float32)
+    return x.astype(np.float64), y
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--rows", type=int, default=1_000_000)
+    parser.add_argument("--features", type=int, default=28)
+    parser.add_argument("--leaves", type=int, default=255)
+    parser.add_argument("--max-bin", type=int, default=255)
+    parser.add_argument("--warmup", type=int, default=2)
+    parser.add_argument("--iters", type=int, default=5)
+    args = parser.parse_args()
+
+    import jax
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.config import OverallConfig
+    from lightgbm_tpu.io.dataset import Dataset
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.objectives import create_objective
+
+    x, y = make_data(args.rows, args.features)
+    ds = Dataset.from_arrays(x, y, max_bin=args.max_bin)
+
+    cfg = OverallConfig()
+    cfg.set({
+        "objective": "binary",
+        "num_leaves": str(args.leaves),
+        "min_data_in_leaf": "100",
+        "min_sum_hessian_in_leaf": "10.0",
+        "learning_rate": "0.1",
+        "num_iterations": str(args.warmup + args.iters),
+    }, require_data=False)
+
+    booster = GBDT()
+    objective = create_objective(cfg.objective_type, cfg.objective_config)
+    booster.init(cfg.boosting_config, ds, objective)
+
+    for _ in range(args.warmup):
+        booster.train_one_iter(is_eval=False)
+    jax.block_until_ready(booster.score)
+
+    start = time.time()
+    for _ in range(args.iters):
+        booster.train_one_iter(is_eval=False)
+    jax.block_until_ready(booster.score)
+    elapsed = time.time() - start
+
+    iters_per_sec = args.iters / elapsed
+    print(json.dumps({
+        "metric": f"boosting_iters_per_sec_higgs{args.rows // 1000}k_"
+                  f"leaves{args.leaves}",
+        "value": round(iters_per_sec, 4),
+        "unit": "iters/sec",
+        "vs_baseline": round(iters_per_sec / REFERENCE_CPU_ITERS_PER_SEC, 4),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

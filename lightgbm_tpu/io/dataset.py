@@ -89,7 +89,8 @@ class Dataset:
         if os.path.exists(bin_path):
             log.info("Loading data set from binary file")
             self._load_binary(bin_path, rank, num_machines,
-                              io_config.is_pre_partition)
+                              io_config.is_pre_partition,
+                              io_config.data_random_seed)
             self._attach_init_score(io_config.input_init_score, predict_fun)
             return self
 
@@ -361,7 +362,7 @@ class Dataset:
         log.info("Saved binary data file to %s" % path)
 
     def _load_binary(self, path: str, rank: int, num_machines: int,
-                     is_pre_partition: bool) -> None:
+                     is_pre_partition: bool, data_random_seed: int = 1) -> None:
         with open(path, "rb") as f:
             magic = f.read(len(BINARY_MAGIC))
             if magic != BINARY_MAGIC:
@@ -386,9 +387,18 @@ class Dataset:
         self.metadata.weights = header["weights"]
         self.metadata.query_boundaries = header["query_boundaries"]
         if num_machines > 1 and not is_pre_partition:
-            # re-shard cached data (dataset.cpp:840-872)
-            rng = np.random.RandomState(1)
-            mask = rng.randint(0, num_machines, size=self.num_data) == rank
+            # re-shard cached data (dataset.cpp:840-872); query-atomic when
+            # query boundaries exist, same seed as the fresh-load path so
+            # cached and fresh runs shard identically
+            rng = np.random.RandomState(data_random_seed)
+            qb = self.metadata.query_boundaries
+            if qb is not None:
+                q_owner = rng.randint(0, num_machines, size=qb.size - 1)
+                row_query = np.searchsorted(qb, np.arange(self.num_data),
+                                            side="right") - 1
+                mask = q_owner[row_query] == rank
+            else:
+                mask = rng.randint(0, num_machines, size=self.num_data) == rank
             idx = np.nonzero(mask)[0]
             self.bins = np.ascontiguousarray(self.bins[:, idx])
             self.metadata.partition(idx, self.num_data)

@@ -96,7 +96,8 @@ def grow_tree_impl(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                    min_data_in_leaf: int, min_sum_hessian_in_leaf: float,
                    max_depth: int = -1, hist_backend: str = "matmul",
                    hist_chunk: int = 16384, hist_reduce=None,
-                   split_finder=None, partition_bins=None) -> TreeArrays:
+                   split_finder=None, partition_bins=None,
+                   stat_reduce=None) -> TreeArrays:
     """Core grower (not jitted; callers wrap it).
 
     Parameters
@@ -150,10 +151,17 @@ def grow_tree_impl(bins: jax.Array, grad: jax.Array, hess: jax.Array,
 
     # ---- root init (BeforeTrain, serial_tree_learner.cpp:155-236)
     root_hist = hist_of(row_mask)
-    # every feature's bins sum to the same leaf totals; use feature 0's
-    root_g = jnp.sum(root_hist[0, :, 0])
-    root_h = jnp.sum(root_hist[0, :, 1])
-    root_c = jnp.sum(root_hist[0, :, 2])
+    # root sums come from the gradient vectors, not from any one feature's
+    # histogram: per-feature f32 bin-order rounding would make the totals
+    # shard-dependent under feature-parallel ownership (the reference
+    # likewise computes root sums once from gradients,
+    # serial_tree_learner.cpp:178-198 / data_parallel root-sum allreduce)
+    maskf = row_mask.astype(f32)
+    root_stats = jnp.stack([jnp.sum(grad * maskf), jnp.sum(hess * maskf),
+                            jnp.sum(maskf)])
+    if stat_reduce is not None:
+        root_stats = stat_reduce(root_stats)
+    root_g, root_h, root_c = root_stats[0], root_stats[1], root_stats[2]
     root_best = best_of(root_hist, root_g, root_h, root_c,
                         jnp.asarray(1, jnp.int32))
 

@@ -1,0 +1,6 @@
+#!/bin/sh
+# Build the native host-runtime library (see src/lgbm_native.cpp).
+set -e
+cd "$(dirname "$0")"
+g++ -O3 -fopenmp -shared -fPIC -std=c++17 src/lgbm_native.cpp -o liblgbm_native.so
+echo "built $(pwd)/liblgbm_native.so"

@@ -1,0 +1,121 @@
+// Native host-runtime kernels for lightgbm_tpu.
+//
+// The reference implements its whole runtime in C++ (parsers at
+// src/io/parser.hpp, pipelined text reading at utils/text_reader.h /
+// pipeline_reader.h, locale-free Atof at utils/common.h).  In this
+// framework the device compute is XLA; this library keeps the HOST hot
+// paths native: delimited text -> float64 matrix parsing (OpenMP over
+// rows) and value->bin quantization.  Loaded via ctypes
+// (lightgbm_tpu/native/lib.py); every entry point has a NumPy fallback.
+//
+// Build: lightgbm_tpu/native/build.sh  (g++ -O3 -fopenmp -shared -fPIC)
+
+#include <cctype>
+#include <cmath>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+#if defined(_OPENMP)
+#include <omp.h>
+#endif
+
+namespace {
+
+// Locale-free float parse; na/nan/garbage parse as 0 like the reference's
+// Atof (utils/common.h:177-178 treats na/nan as 0).
+inline double parse_token(const char* begin, const char* end) {
+  while (begin < end && (*begin == ' ' || *begin == '\t')) ++begin;
+  if (begin >= end) return 0.0;
+  char buf[64];
+  size_t len = static_cast<size_t>(end - begin);
+  if (len >= sizeof(buf)) len = sizeof(buf) - 1;
+  std::memcpy(buf, begin, len);
+  buf[len] = '\0';
+  char* parse_end = nullptr;
+  double value = std::strtod(buf, &parse_end);
+  if (parse_end == buf) return 0.0;  // na / nan / unparseable
+  if (std::isnan(value)) return 0.0;
+  return value;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Parse `nrows` lines of `delim`-separated numbers from `blob` into the
+// preallocated row-major out[nrows*ncols].  Returns 0 on success, nonzero
+// when any line has the wrong column count (caller falls back to Python
+// for the precise reference-style error).
+int parse_delimited(const char* blob, long long blob_len, char delim,
+                    long long nrows, long long ncols, double* out) {
+  // pass 1: line starts
+  std::vector<const char*> starts;
+  starts.reserve(static_cast<size_t>(nrows) + 1);
+  const char* p = blob;
+  const char* end = blob + blob_len;
+  starts.push_back(p);
+  for (const char* q = p; q < end; ++q) {
+    if (*q == '\n' && q + 1 < end) starts.push_back(q + 1);
+  }
+  if (static_cast<long long>(starts.size()) < nrows) return 1;
+
+  int bad = 0;
+  // pass 2: parse rows in parallel
+#pragma omp parallel for schedule(static)
+  for (long long i = 0; i < nrows; ++i) {
+    const char* line = starts[static_cast<size_t>(i)];
+    const char* line_end =
+        (i + 1 < static_cast<long long>(starts.size()))
+            ? starts[static_cast<size_t>(i + 1)] - 1
+            : end;
+    while (line_end > line && (line_end[-1] == '\n' || line_end[-1] == '\r'))
+      --line_end;
+    long long col = 0;
+    const char* tok = line;
+    for (const char* q = line; q <= line_end; ++q) {
+      if (q == line_end || *q == delim) {
+        if (col < ncols) out[i * ncols + col] = parse_token(tok, q);
+        ++col;
+        tok = q + 1;
+      }
+    }
+    if (col != ncols) {
+#pragma omp atomic write
+      bad = 1;
+    }
+  }
+  return bad;
+}
+
+// Quantize values[n] into bins via upper-bound binary search
+// (BinMapper::ValueToBin, include/LightGBM/bin.h:296-309): first bin whose
+// upper bound >= value; bounds has num_bin entries, last is +inf.
+void value_to_bin(const double* values, long long n, const double* bounds,
+                  int num_bin, unsigned char* out) {
+#pragma omp parallel for schedule(static)
+  for (long long i = 0; i < n; ++i) {
+    int lo = 0, hi = num_bin - 1;
+    double v = values[i];
+    while (lo < hi) {
+      int mid = (lo + hi - 1) / 2;
+      if (v <= bounds[mid]) {
+        hi = mid;
+      } else {
+        lo = mid + 1;
+      }
+    }
+    out[i] = static_cast<unsigned char>(lo);
+  }
+}
+
+int num_threads() {
+#if defined(_OPENMP)
+  return omp_get_max_threads();
+#else
+  return 1;
+#endif
+}
+
+}  // extern "C"

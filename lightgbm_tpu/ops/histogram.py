@@ -23,6 +23,9 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+# transient one-hot working-set budget (bytes) for the chunked matmul
+CHUNK_BYTE_BUDGET = 256 << 20
+
 
 def histogram_matmul(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                      mask: jax.Array, num_bins_max: int,
@@ -44,6 +47,10 @@ def histogram_matmul(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     """
     F, N = bins.shape
     B = num_bins_max
+    # bound the transient one-hot working set ([F, chunk, B] floats) by a
+    # byte budget so wide datasets don't OOM; the chunk arg is a ceiling
+    budget_rows = max(CHUNK_BYTE_BUDGET // (F * B * 4), 256)
+    chunk = min(chunk, -(-budget_rows // 256) * 256)
     maskf = mask.astype(compute_dtype)
     vals = jnp.stack([grad.astype(compute_dtype) * maskf,
                       hess.astype(compute_dtype) * maskf,

@@ -117,6 +117,7 @@ class DataParallelLearner(_ParallelLearnerBase):
                 return grow_tree_impl(
                     bins_s, grad_s, hess_s, mask_s, fmask, nbins,
                     hist_reduce=lambda h: jax.lax.psum(h, DATA_AXIS),
+                    stat_reduce=lambda s: jax.lax.psum(s, DATA_AXIS),
                     **kwargs)
 
             self._jitted = jax.jit(shard_map(

@@ -1,0 +1,93 @@
+"""Config system tests (aliases, conflicts, file parsing) —
+/root/reference config.cpp parity."""
+import os
+
+import pytest
+
+from lightgbm_tpu.config import (OverallConfig, apply_aliases, load_config,
+                                 parse_config_file)
+from lightgbm_tpu.utils.log import LightGBMError
+
+
+def _set(params, **kw):
+    cfg = OverallConfig()
+    cfg.set(dict(params), require_data=kw.get("require_data", False))
+    return cfg
+
+
+def test_aliases():
+    out = apply_aliases({"num_tree": "50", "sub_feature": "0.5",
+                         "min_data": "10"})
+    assert out["num_iterations"] == "50"
+    assert out["feature_fraction"] == "0.5"
+    assert out["min_data_in_leaf"] == "10"
+
+
+def test_alias_does_not_override_canonical():
+    out = apply_aliases({"num_tree": "50", "num_iterations": "99"})
+    assert out["num_iterations"] == "99"
+
+
+def test_defaults():
+    cfg = _set({})
+    assert cfg.boosting_config.num_iterations == 10
+    assert cfg.boosting_config.learning_rate == 0.1
+    assert cfg.boosting_config.tree_config.num_leaves == 127
+    assert cfg.boosting_config.tree_config.min_data_in_leaf == 100
+    assert cfg.io_config.max_bin == 256
+    assert cfg.metric_config.eval_at == [1, 2, 3, 4, 5]
+    assert cfg.objective_config.label_gain[2] == 3.0  # 2^2-1
+
+
+def test_multiclass_conflict():
+    with pytest.raises(LightGBMError):
+        _set({"objective": "multiclass", "num_class": "1"})
+    with pytest.raises(LightGBMError):
+        _set({"objective": "binary", "num_class": "3"})
+    with pytest.raises(LightGBMError):
+        _set({"objective": "binary", "metric": "multi_logloss"})
+
+
+def test_parallel_conflict_resolution():
+    # serial forces num_machines=1 (config.cpp:164-167)
+    cfg = _set({"tree_learner": "serial", "num_machines": "4"})
+    assert cfg.network_config.num_machines == 1
+    assert not cfg.is_parallel
+    # data-parallel keeps machines and enables parallel bin finding
+    cfg = _set({"tree_learner": "data", "num_machines": "4"})
+    assert cfg.is_parallel
+    assert cfg.is_parallel_find_bin
+
+
+def test_voting_rejected():
+    # this snapshot rejects tree_learner=voting (config.cpp:311-313)
+    with pytest.raises(LightGBMError):
+        _set({"tree_learner": "voting", "num_machines": "2"})
+
+
+def test_bad_values():
+    with pytest.raises(LightGBMError):
+        _set({"num_leaves": "1"})
+    with pytest.raises(LightGBMError):
+        _set({"learning_rate": "abc"})
+    with pytest.raises(LightGBMError):
+        _set({"bagging_fraction": "1.5"})
+    with pytest.raises(LightGBMError):
+        _set({"task": "explode"})
+
+
+def test_config_file_and_argv_priority(tmp_path):
+    conf = tmp_path / "t.conf"
+    conf.write_text("# comment\nnum_trees = 77\nlearning_rate = 0.3  # tail\n"
+                    "data = train.txt\n")
+    params = parse_config_file(str(conf))
+    assert params["num_trees"] == "77"
+    assert params["learning_rate"] == "0.3"
+    # argv wins over file (application.cpp:98)
+    cfg = load_config([f"config={conf}", "num_trees=5"])
+    assert cfg.boosting_config.num_iterations == 5
+
+
+def test_metric_dedup():
+    cfg = _set({"metric": "auc,auc,binary_logloss"})
+    assert cfg.metric_types == ["auc", "binary_logloss"]

@@ -1,0 +1,120 @@
+"""Dataset/parser tests: format sniffing, column roles, side files,
+native-vs-Python parser equality."""
+import os
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.config import IOConfig
+from lightgbm_tpu.io import parser as parser_mod
+from lightgbm_tpu.io.dataset import Dataset, _resolve_columns
+
+
+def _write(path, text):
+    with open(path, "w") as f:
+        f.write(text)
+    return str(path)
+
+
+def test_format_sniffing(tmp_path):
+    csv = _write(tmp_path / "a.csv", "1,2,3\n4,5,6\n")
+    tsv = _write(tmp_path / "a.tsv", "1\t2\t3\n4\t5\t6\n")
+    svm = _write(tmp_path / "a.svm", "1 0:0.5 2:1.5\n0 1:2.0\n")
+    assert parser_mod.create_parser(csv, False, 0, 0).format_name == "csv"
+    assert parser_mod.create_parser(tsv, False, 0, 0).format_name == "tsv"
+    assert parser_mod.create_parser(svm, False, 0, 0).format_name == "libsvm"
+
+
+def test_csv_parse_with_label():
+    p = parser_mod.CSVParser(label_idx=0)
+    parsed = p.parse(["1,0.5,na,2.0", "0,1.5,3.0,0"])
+    np.testing.assert_allclose(parsed.labels, [1.0, 0.0])
+    np.testing.assert_allclose(parsed.features,
+                               [[0.5, 0.0, 2.0], [1.5, 3.0, 0.0]])
+
+
+def test_libsvm_parse():
+    p = parser_mod.LibSVMParser(label_idx=0)
+    parsed = p.parse(["1 0:0.5 3:2.0", "0 1:1.5"])
+    np.testing.assert_allclose(parsed.labels, [1.0, 0.0])
+    assert parsed.features.shape == (2, 4)
+    assert parsed.features[0, 3] == 2.0
+    assert parsed.features[1, 1] == 1.5
+
+
+def test_predict_time_label_heuristic(tmp_path):
+    # file with num_features columns (no label) → label_idx becomes -1
+    path = _write(tmp_path / "nolabel.csv", "1,2,3\n4,5,6\n")
+    p = parser_mod.create_parser(path, False, 3, 0)
+    assert p.label_idx == -1
+    parsed = p.parse(["1,2,3"])
+    assert parsed.features.shape == (1, 3)
+    np.testing.assert_allclose(parsed.labels, [0.0])
+
+
+def test_column_resolution_by_name(tmp_path):
+    data = _write(tmp_path / "d.csv",
+                  "lbl,f1,wgt,f2\n1,0.5,2.0,3.0\n0,1.5,1.0,4.0\n")
+    cfg = IOConfig(data_filename=data, has_header=True,
+                   label_column="name:lbl", weight_column="name:wgt")
+    label_idx, weight_idx, group_idx, ignore, names = _resolve_columns(cfg)
+    assert label_idx == 0
+    # wgt is raw col 2 → feature-space 1 after label removal
+    assert weight_idx == 1
+    assert weight_idx in ignore
+    assert names == ["f1", "wgt", "f2"]
+
+
+def test_load_train_weight_column(tmp_path):
+    data = _write(tmp_path / "d.csv",
+                  "lbl,f1,wgt,f2\n" + "\n".join(
+                      f"{i % 2},{i * 0.1},{1.0 + i},{3.0 - i * 0.1}"
+                      for i in range(50)) + "\n")
+    cfg = IOConfig(data_filename=data, has_header=True,
+                   label_column="name:lbl", weight_column="name:wgt")
+    ds = Dataset.load_train(cfg)
+    # weight column captured into metadata, excluded from features
+    np.testing.assert_allclose(ds.metadata.weights,
+                               [1.0 + i for i in range(50)])
+    assert all(j != 1 for j in ds.used_feature_map)  # wgt not a feature
+    assert ds.metadata.label[1] == 1.0
+
+
+def test_side_files(tmp_path):
+    data = _write(tmp_path / "rank.txt", "\n".join(
+        f"{i % 3}\t{i * 0.1}\t{i * 0.2}" for i in range(30)) + "\n")
+    _write(tmp_path / "rank.txt.weight",
+           "\n".join("1.5" for _ in range(30)) + "\n")
+    _write(tmp_path / "rank.txt.query", "10\n20\n")
+    cfg = IOConfig(data_filename=data)
+    ds = Dataset.load_train(cfg)
+    np.testing.assert_allclose(ds.metadata.weights, 1.5)
+    np.testing.assert_array_equal(ds.metadata.query_boundaries, [0, 10, 30])
+    # query weights = per-query mean of record weights
+    np.testing.assert_allclose(ds.metadata.query_weights, [1.5, 1.5])
+
+
+def test_trivial_feature_dropped(tmp_path):
+    data = _write(tmp_path / "t.csv", "\n".join(
+        f"{i % 2},{i * 1.0},7.0" for i in range(20)) + "\n")
+    cfg = IOConfig(data_filename=data)
+    ds = Dataset.load_train(cfg)
+    # constant column dropped; real_feature_idx keeps original numbering
+    assert ds.num_features == 1
+    assert list(ds.real_feature_idx) == [0]
+
+
+def test_native_parser_matches_python():
+    from lightgbm_tpu.native import lib
+    if not lib.available():
+        pytest.skip("native library not built")
+    rng = np.random.RandomState(0)
+    rows = []
+    for _ in range(200):
+        vals = rng.randn(5).round(4)
+        rows.append(",".join(str(v) for v in vals))
+    rows[7] = "na,1.0,nan,-2.5,0"
+    native = lib.parse_delimited(rows, ",")
+    python = np.array([[parser_mod._atof(t) for t in r.split(",")]
+                       for r in rows])
+    np.testing.assert_allclose(native, python)

@@ -1,0 +1,109 @@
+"""Golden end-to-end fixtures: the reference's bundled example tasks run
+through the CLI Application with configs unchanged (apart from speed
+overrides), asserting metric trajectories — the reference's de-facto test
+suite (SURVEY §4, examples/README.md)."""
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.cli import Application
+
+EXAMPLES = "/root/reference/examples"
+
+
+def _run_example(tmp_path, task_dir, files, overrides, monkeypatch):
+    src = os.path.join(EXAMPLES, task_dir)
+    if not os.path.isdir(src):
+        pytest.skip("reference examples not available")
+    for f in files:
+        shutil.copy(os.path.join(src, f), tmp_path / f)
+    monkeypatch.chdir(tmp_path)
+    app = Application(["config=train.conf"] + overrides)
+    app.run()
+    return app
+
+
+def _predict_example(tmp_path, monkeypatch, overrides=()):
+    monkeypatch.chdir(tmp_path)
+    app = Application(["config=predict.conf"] + list(overrides))
+    app.run()
+    return np.loadtxt(tmp_path / "LightGBM_predict_result.txt")
+
+
+FAST = ["num_trees=5", "num_leaves=15", "min_data_in_leaf=20"]
+
+
+def test_binary_classification(tmp_path, monkeypatch):
+    app = _run_example(
+        tmp_path, "binary_classification",
+        ["binary.train", "binary.test", "binary.train.weight",
+         "binary.test.weight", "train.conf", "predict.conf"],
+        FAST, monkeypatch)
+    # model written in reference format
+    model_text = (tmp_path / "LightGBM_model.txt").read_text()
+    assert model_text.startswith("gbdt\n")
+    assert model_text.count("Tree=") == 5
+    assert "feature importances:" in model_text
+    # AUC above chance after 5 trees
+    auc = app.boosting.valid_metrics[0][1].eval(
+        np.asarray(app.boosting.valid_datasets[0]["score"][0]))[0]
+    assert auc > 0.7
+    preds = _predict_example(tmp_path, monkeypatch)
+    assert preds.shape[0] == 500
+    assert ((preds >= 0) & (preds <= 1)).all()
+
+
+def test_regression(tmp_path, monkeypatch):
+    app = _run_example(
+        tmp_path, "regression",
+        ["regression.train", "regression.test", "train.conf", "predict.conf"],
+        FAST, monkeypatch)
+    metric = app.boosting.valid_metrics[0][0]
+    rmse = metric.eval(np.asarray(app.boosting.valid_datasets[0]["score"][0]))[0]
+    # labels are 0/1 in this example; scores start at 0 → initial RMSE ≈
+    # sqrt(mean(y²)) ≈ 0.707; five small trees at lr=0.05 must cut it
+    assert rmse < 0.68
+    preds = _predict_example(tmp_path, monkeypatch)
+    assert np.isfinite(preds).all()
+
+
+def test_multiclass(tmp_path, monkeypatch):
+    app = _run_example(
+        tmp_path, "multiclass_classification",
+        ["multiclass.train", "multiclass.test", "train.conf", "predict.conf"],
+        ["num_trees=3", "num_leaves=15", "min_data_in_leaf=20"], monkeypatch)
+    assert len(app.boosting.models) == 3 * 5  # interleaved per class
+    preds = _predict_example(tmp_path, monkeypatch)
+    assert preds.shape == (500, 5)
+    np.testing.assert_allclose(preds.sum(axis=1), 1.0, atol=1e-4)
+
+
+def test_lambdarank(tmp_path, monkeypatch):
+    app = _run_example(
+        tmp_path, "lambdarank",
+        ["rank.train", "rank.test", "rank.train.query", "rank.test.query",
+         "train.conf", "predict.conf"],
+        ["num_trees=5", "num_leaves=15", "min_data_in_leaf=10"], monkeypatch)
+    metric = app.boosting.valid_metrics[0][0]
+    ndcgs = metric.eval(np.asarray(app.boosting.valid_datasets[0]["score"][0]))
+    assert all(v > 0.4 for v in ndcgs)
+    preds = _predict_example(tmp_path, monkeypatch)
+    assert np.isfinite(preds).all()
+
+
+def test_binary_save_binary_cache(tmp_path, monkeypatch):
+    """Dataset binary cache: second run loads <data>.bin (dataset.cpp:653-898)."""
+    app = _run_example(
+        tmp_path, "binary_classification",
+        ["binary.train", "binary.test", "binary.train.weight",
+         "binary.test.weight", "train.conf", "predict.conf"],
+        FAST + ["is_save_binary_file=true"], monkeypatch)
+    assert (tmp_path / "binary.train.bin").exists()
+    score1 = np.asarray(app.boosting.score[0]).copy()
+    # retrain from the cache; identical data → identical first-model scores
+    app2 = Application(["config=train.conf"] + FAST)
+    app2.run()
+    np.testing.assert_allclose(np.asarray(app2.boosting.score[0]), score1,
+                               rtol=1e-5, atol=1e-6)

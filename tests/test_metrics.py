@@ -1,0 +1,127 @@
+"""Metric tests vs manual computations."""
+import numpy as np
+import pytest
+
+from lightgbm_tpu.config import MetricConfig
+from lightgbm_tpu.io.metadata import Metadata
+from lightgbm_tpu.metrics import create_metric
+from lightgbm_tpu.metrics.dcg import DCGCalculator
+
+
+def _meta(label, weights=None, boundaries=None):
+    m = Metadata()
+    m.set_label(np.asarray(label, np.float32))
+    if weights is not None:
+        m.weights = np.asarray(weights, np.float32)
+    if boundaries is not None:
+        m.query_boundaries = np.asarray(boundaries, np.int32)
+        m._load_query_weights()
+    return m
+
+
+def test_l2_reports_rmse():
+    metric = create_metric("l2", MetricConfig())
+    metric.init("t", _meta([0.0, 0.0]), 2)
+    # errors 1, 3 → mse 5 → rmse sqrt(5) (regression_metric.hpp:100-103)
+    assert metric.eval(np.array([1.0, 3.0]))[0] == pytest.approx(np.sqrt(5))
+
+
+def test_l1():
+    metric = create_metric("l1", MetricConfig())
+    metric.init("t", _meta([1.0, -1.0]), 2)
+    assert metric.eval(np.array([2.0, 1.0]))[0] == pytest.approx(1.5)
+
+
+def test_binary_logloss():
+    metric = create_metric("binary_logloss", MetricConfig())
+    label = np.array([1.0, 0.0])
+    metric.init("t", _meta(label), 2)
+    score = np.array([0.5, -0.5])
+    prob = 1 / (1 + np.exp(-2 * score))
+    expected = np.mean([-np.log(prob[0]), -np.log(1 - prob[1])])
+    assert metric.eval(score)[0] == pytest.approx(expected, rel=1e-6)
+
+
+def test_binary_error():
+    metric = create_metric("binary_error", MetricConfig())
+    metric.init("t", _meta([1.0, 1.0, 0.0, 0.0]), 4)
+    # scores: +,-,+,- → predictions 1,0,1,0 → errors at idx 1,2
+    assert metric.eval(np.array([1.0, -1.0, 1.0, -1.0]))[0] == pytest.approx(0.5)
+
+
+def test_auc_perfect_and_random():
+    metric = create_metric("auc", MetricConfig())
+    label = np.array([1.0, 1.0, 0.0, 0.0])
+    metric.init("t", _meta(label), 4)
+    assert metric.eval(np.array([4.0, 3.0, 2.0, 1.0]))[0] == pytest.approx(1.0)
+    assert metric.eval(np.array([1.0, 2.0, 3.0, 4.0]))[0] == pytest.approx(0.0)
+    # all-tied scores → AUC 0.5
+    assert metric.eval(np.zeros(4))[0] == pytest.approx(0.5)
+
+
+def test_auc_matches_pairwise_definition():
+    rng = np.random.RandomState(0)
+    label = (rng.rand(300) > 0.6).astype(np.float32)
+    score = rng.randn(300)
+    metric = create_metric("auc", MetricConfig())
+    metric.init("t", _meta(label), 300)
+    got = metric.eval(score)[0]
+    pos = score[label == 1]
+    neg = score[label == 0]
+    cmp = (pos[:, None] > neg[None, :]).sum() + 0.5 * (pos[:, None] == neg[None, :]).sum()
+    expected = cmp / (pos.size * neg.size)
+    assert got == pytest.approx(expected, rel=1e-9)
+
+
+def test_multi_metrics():
+    cfg = MetricConfig()
+    cfg.num_class = 3
+    label = np.array([0.0, 1.0, 2.0])
+    score = np.array([[2.0, 0.1, 0.0],
+                      [0.1, 0.2, 0.1],
+                      [0.0, 0.1, 3.0]])  # [K, N], argmax = 0, 1, 2
+    err = create_metric("multi_error", cfg)
+    err.init("t", _meta(label), 3)
+    assert err.eval(score.reshape(-1))[0] == pytest.approx(0.0)
+    ll = create_metric("multi_logloss", cfg)
+    ll.init("t", _meta(label), 3)
+    z = np.exp(score - score.max(axis=0))
+    p = z / z.sum(axis=0)
+    expected = -np.mean([np.log(p[0, 0]), np.log(p[1, 1]), np.log(p[2, 2])])
+    assert ll.eval(score.reshape(-1))[0] == pytest.approx(expected, rel=1e-6)
+
+
+def test_ndcg():
+    cfg = MetricConfig()
+    cfg.eval_at = [1, 2]
+    metric = create_metric("ndcg", cfg)
+    label = np.array([2.0, 1.0, 0.0, 1.0, 0.0])
+    metric.init("t", _meta(label, boundaries=[0, 3, 5]), 5)
+    # perfect ordering → NDCG 1 at every k
+    out = metric.eval(np.array([3.0, 2.0, 1.0, 2.0, 1.0]))
+    assert out[0] == pytest.approx(1.0)
+    assert out[1] == pytest.approx(1.0)
+
+
+def test_ndcg_all_negative_query_counts_one():
+    cfg = MetricConfig()
+    cfg.eval_at = [1]
+    metric = create_metric("ndcg", cfg)
+    label = np.array([0.0, 0.0, 2.0, 0.0])
+    metric.init("t", _meta(label, boundaries=[0, 2, 4]), 4)
+    out = metric.eval(np.array([1.0, 0.0, 1.0, 0.0]))
+    # query 1 all-negative → 1.0; query 2 perfect → 1.0 (rank_metric.hpp:98-101)
+    assert out[0] == pytest.approx(1.0)
+
+
+def test_dcg_calculator():
+    gains = [0.0, 1.0, 3.0, 7.0]
+    dcg = DCGCalculator(gains)
+    label = np.array([3, 1, 2])
+    # max DCG@3: sorted labels 3,2,1 → 7/log2(2)+3/log2(3)+1/log2(4)
+    expected = 7 / np.log2(2) + 3 / np.log2(3) + 1 / np.log2(4)
+    assert dcg.cal_max_dcg_at_k(3, label) == pytest.approx(expected)
+    # DCG under score order [10, 5, 1] = label order 3,1,2
+    got = dcg.cal_dcg([3], label, np.array([10.0, 5.0, 1.0]))[0]
+    expected2 = 7 / np.log2(2) + 1 / np.log2(3) + 3 / np.log2(4)
+    assert got == pytest.approx(expected2)

@@ -1,0 +1,114 @@
+"""Objective gradient/hessian tests against closed forms
+(/root/reference/src/objective parity)."""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from lightgbm_tpu.config import ObjectiveConfig
+from lightgbm_tpu.io.metadata import Metadata
+from lightgbm_tpu.objectives import create_objective
+
+
+def _meta(label, weights=None, boundaries=None):
+    m = Metadata()
+    m.set_label(np.asarray(label, np.float32))
+    if weights is not None:
+        m.weights = np.asarray(weights, np.float32)
+    if boundaries is not None:
+        m.query_boundaries = np.asarray(boundaries, np.int32)
+    return m
+
+
+def test_regression_l2():
+    obj = create_objective("regression", ObjectiveConfig())
+    label = np.array([1.0, -2.0, 0.5])
+    obj.init(_meta(label), 3)
+    score = jnp.array([0.0, 1.0, 0.5])
+    g, h = obj.get_gradients(score)
+    np.testing.assert_allclose(np.asarray(g), [-1.0, 3.0, 0.0], atol=1e-6)
+    np.testing.assert_allclose(np.asarray(h), [1.0, 1.0, 1.0])
+
+
+def test_regression_weighted():
+    obj = create_objective("regression", ObjectiveConfig())
+    obj.init(_meta([1.0, 0.0], weights=[2.0, 0.5]), 2)
+    g, h = obj.get_gradients(jnp.array([0.0, 1.0]))
+    np.testing.assert_allclose(np.asarray(g), [-2.0, 0.5], atol=1e-6)
+    np.testing.assert_allclose(np.asarray(h), [2.0, 0.5])
+
+
+def test_binary_logloss_closed_form():
+    cfg = ObjectiveConfig()
+    cfg.sigmoid = 1.0
+    obj = create_objective("binary", cfg)
+    label = np.array([1.0, 0.0, 1.0, 0.0])
+    obj.init(_meta(label), 4)
+    score = np.array([0.3, -0.7, 0.0, 2.0], np.float32)
+    g, h = obj.get_gradients(jnp.asarray(score))
+    # reference formula (binary_objective.hpp:55-81)
+    sign = np.where(label == 1, 1.0, -1.0)
+    response = -2.0 * sign / (1.0 + np.exp(2.0 * sign * score))
+    np.testing.assert_allclose(np.asarray(g), response, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(h),
+                               np.abs(response) * (2.0 - np.abs(response)),
+                               rtol=1e-5)
+
+
+def test_binary_single_class_fatal():
+    from lightgbm_tpu.utils.log import LightGBMError
+    obj = create_objective("binary", ObjectiveConfig())
+    with pytest.raises(LightGBMError):
+        obj.init(_meta([1.0, 1.0, 1.0]), 3)
+
+
+def test_binary_unbalance_weights():
+    cfg = ObjectiveConfig()
+    cfg.is_unbalance = True
+    obj = create_objective("binary", cfg)
+    label = np.array([1.0, 0.0, 0.0, 0.0])  # pos/neg = 1/3
+    obj.init(_meta(label), 4)
+    g, _ = obj.get_gradients(jnp.zeros(4))
+    # negatives reweighted by cnt_pos/cnt_neg = 1/3 (binary_objective.hpp:49-52)
+    assert abs(g[1]) == pytest.approx(abs(g[0]) / 3, rel=1e-5)
+
+
+def test_multiclass_softmax():
+    cfg = ObjectiveConfig()
+    cfg.num_class = 3
+    obj = create_objective("multiclass", cfg)
+    label = np.array([0.0, 2.0, 1.0])
+    obj.init(_meta(label), 3)
+    score = np.array([[1.0, 0.0, -1.0],
+                      [0.0, 1.0, 0.5],
+                      [2.0, -1.0, 0.0]], np.float32)  # [K, N]
+    g, h = obj.get_gradients(jnp.asarray(score))
+    z = np.exp(score - score.max(axis=0))
+    p = z / z.sum(axis=0)
+    onehot = np.eye(3)[label.astype(int)].T
+    np.testing.assert_allclose(np.asarray(g), p - onehot, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(h), 2 * p * (1 - p), rtol=1e-5)
+
+
+def test_lambdarank_gradients_sane():
+    cfg = ObjectiveConfig()
+    obj = create_objective("lambdarank", cfg)
+    # two queries: [3 docs], [2 docs]
+    label = np.array([2.0, 0.0, 1.0, 1.0, 0.0])
+    obj.init(_meta(label, boundaries=[0, 3, 5]), 5)
+    score = jnp.array([0.1, 0.9, 0.2, 0.0, 0.3])
+    g, h = obj.get_gradients(score)
+    g, h = np.asarray(g), np.asarray(h)
+    # lambdas sum to ~0 within a query (pairwise antisymmetry)
+    assert abs(g[:3].sum()) < 1e-4
+    assert abs(g[3:].sum()) < 1e-4
+    # the best-labeled doc with low score is pushed up (negative gradient)
+    assert g[0] < 0
+    # hessians nonnegative
+    assert (h >= -1e-6).all()
+
+
+def test_lambdarank_requires_queries():
+    from lightgbm_tpu.utils.log import LightGBMError
+    obj = create_objective("lambdarank", ObjectiveConfig())
+    with pytest.raises(LightGBMError):
+        obj.init(_meta([1.0, 0.0]), 2)

@@ -1,0 +1,121 @@
+"""Differential tests: serial ≡ data-parallel ≡ feature-parallel trees on a
+virtual 8-device CPU mesh — the reference's own invariant
+(data_parallel_tree_learner.cpp: every worker ends each split with the
+identical global best split), which SURVEY §4 recommends encoding as a test.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import OverallConfig
+from lightgbm_tpu.io.dataset import Dataset
+from lightgbm_tpu.models.gbdt import GBDT
+from lightgbm_tpu.objectives import create_objective
+
+
+def _make_config(tree_learner, num_machines):
+    cfg = OverallConfig()
+    cfg.set({"objective": "binary", "num_leaves": "15",
+             "min_data_in_leaf": "20", "min_sum_hessian_in_leaf": "1.0",
+             "num_iterations": "5", "learning_rate": "0.2",
+             "tree_learner": tree_learner,
+             "num_machines": str(num_machines)}, require_data=False)
+    return cfg
+
+
+def _train_with(tree_learner, num_machines, x, y):
+    cfg = _make_config(tree_learner, num_machines)
+    ds = Dataset.from_arrays(x, y, max_bin=32)
+    booster = GBDT()
+    objective = create_objective(cfg.objective_type, cfg.objective_config)
+    learner = None
+    if tree_learner != "serial":
+        from lightgbm_tpu.parallel import create_parallel_learner
+        learner = create_parallel_learner(cfg)
+    booster.init(cfg.boosting_config, ds, objective, learner=learner)
+    for _ in range(cfg.boosting_config.num_iterations):
+        if booster.train_one_iter(is_eval=False):
+            break
+    return booster
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.RandomState(21)
+    n, f = 1600, 10
+    x = rng.randn(n, f)
+    y = ((x[:, 0] - 0.5 * x[:, 1] + 0.2 * rng.randn(n)) > 0).astype(np.float32)
+    return x, y
+
+
+def _tree_fingerprint(booster):
+    out = []
+    for t in booster.models:
+        out.append((t.num_leaves, tuple(t.split_feature_real),
+                    tuple(t.threshold_bin), tuple(np.round(t.leaf_value, 5))))
+    return out
+
+
+def test_requires_8_devices():
+    assert len(jax.devices()) >= 8
+
+
+def _assert_equivalent_to_serial(serial, parallel, x):
+    """Parallel learners must reproduce serial trees up to f32 near-ties.
+
+    Bitwise serial≡parallel equality is not achievable: reductions run in a
+    different order (single-device sum vs psum of partials), so a split
+    whose two candidates differ by < 1 ulp may resolve differently.  The
+    reference has the same property (its guarantee is identical trees
+    ACROSS WORKERS, which here holds by construction since the split search
+    is replicated on reduced histograms).  We require: same model count,
+    ≥95% identical split decisions, and near-identical predictions.
+    """
+    assert len(serial.models) == len(parallel.models)
+    same = total = 0
+    for ts, tp in zip(serial.models, parallel.models):
+        n = min(ts.num_leaves, tp.num_leaves) - 1
+        same += int(np.sum(
+            (ts.split_feature_real[:n] == tp.split_feature_real[:n])
+            & (ts.threshold_bin[:n] == tp.threshold_bin[:n])))
+        total += max(ts.num_leaves, tp.num_leaves) - 1
+    assert same / total >= 0.95, f"only {same}/{total} splits identical"
+    diff = np.abs(serial.predict_raw(x) - parallel.predict_raw(x))
+    # rows rerouted by a diverged near-tie split may shift; they must be few
+    assert (diff > 1e-3).mean() < 0.05
+    assert np.median(diff) < 1e-4
+
+
+def test_data_parallel_matches_serial(data):
+    x, y = data
+    serial = _train_with("serial", 1, x, y)
+    dp = _train_with("data", 8, x, y)
+    _assert_equivalent_to_serial(serial, dp, x)
+
+
+def test_feature_parallel_matches_serial(data):
+    x, y = data
+    serial = _train_with("serial", 1, x, y)
+    fp = _train_with("feature", 8, x, y)
+    _assert_equivalent_to_serial(serial, fp, x)
+
+
+def test_feature_parallel_uneven_features(data):
+    """F=10 not divisible by 8 shards — exercises the feature-padding path."""
+    x, y = data
+    fp = _train_with("feature", 8, x, y)
+    # padded phantom features must never be chosen
+    for t in fp.models:
+        assert (np.asarray(t.split_feature_real) < x.shape[1]).all()
+
+
+def test_data_parallel_uneven_rows(data):
+    x, y = data
+    # 1601 rows not divisible by 8
+    x2 = np.concatenate([x, x[:1]])
+    y2 = np.concatenate([y, y[:1]])
+    serial = _train_with("serial", 1, x2, y2)
+    dp = _train_with("data", 8, x2, y2)
+    _assert_equivalent_to_serial(serial, dp, x2)

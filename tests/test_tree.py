@@ -1,0 +1,72 @@
+"""Tree model tests: text round-trip, replay prediction vs naive traversal."""
+import numpy as np
+
+from lightgbm_tpu.models.tree import Tree
+
+
+def _manual_tree():
+    """Hand-built 4-leaf tree mirroring Tree::Split's construction
+    (tree.cpp:50-83): split leaf 0 on f0<=0.5 (node 0), then leaf 0 on
+    f1<=1.5 (node 1), then leaf 1 on f0<=-0.5 (node 2)."""
+    t = Tree(
+        num_leaves=4,
+        split_feature=[0, 1, 0],
+        split_feature_real=[0, 1, 0],
+        threshold_bin=[0, 0, 0],
+        threshold=[0.5, 1.5, -0.5],
+        split_gain=[10.0, 5.0, 2.0],
+        # node0: left=node1(leaf0 split later), right=node2(leaf1 split later)
+        left_child=[1, ~0, ~1],
+        right_child=[2, ~2, ~3],
+        leaf_parent=[1, 2, 1, 2],
+        leaf_value=[1.0, 2.0, 3.0, 4.0],
+    )
+    return t
+
+
+def _naive_predict(tree: Tree, row: np.ndarray) -> float:
+    """Pointer-walk oracle (tree.h:177-187)."""
+    node = 0
+    while node >= 0:
+        if row[tree.split_feature_real[node]] <= tree.threshold[node]:
+            node = tree.left_child[node]
+        else:
+            node = tree.right_child[node]
+    return tree.leaf_value[~node]
+
+
+def test_replay_matches_naive_traversal():
+    t = _manual_tree()
+    rng = np.random.RandomState(0)
+    rows = rng.randn(200, 2) * 2
+    expected = np.array([_naive_predict(t, r) for r in rows])
+    got = t.predict(rows)
+    np.testing.assert_allclose(got, expected)
+
+
+def test_text_roundtrip():
+    t = _manual_tree()
+    s = t.to_string()
+    t2 = Tree.from_string(s)
+    assert t2.num_leaves == 4
+    np.testing.assert_array_equal(t2.left_child, t.left_child)
+    np.testing.assert_array_equal(t2.right_child, t.right_child)
+    np.testing.assert_allclose(t2.threshold, t.threshold)
+    np.testing.assert_allclose(t2.leaf_value, t.leaf_value)
+    rng = np.random.RandomState(1)
+    rows = rng.randn(50, 2)
+    np.testing.assert_allclose(t2.predict(rows), t.predict(rows))
+
+
+def test_single_leaf_tree():
+    t = Tree(num_leaves=1, split_feature=[], split_feature_real=[],
+             threshold_bin=[], threshold=[], split_gain=[], left_child=[],
+             right_child=[], leaf_parent=[-1], leaf_value=[0.25])
+    rows = np.zeros((5, 3))
+    np.testing.assert_allclose(t.predict(rows), 0.25)
+
+
+def test_shrinkage():
+    t = _manual_tree()
+    t.shrinkage(0.1)
+    np.testing.assert_allclose(t.leaf_value, [0.1, 0.2, 0.3, 0.4])
