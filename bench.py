@@ -41,6 +41,10 @@ def main() -> int:
     parser.add_argument("--max-bin", type=int, default=255)
     parser.add_argument("--warmup", type=int, default=2)
     parser.add_argument("--iters", type=int, default=5)
+    parser.add_argument("--grow-policy", default="depthwise",
+                        choices=["depthwise", "leafwise"],
+                        help="depthwise = TPU level-batched histograms "
+                             "(headline); leafwise = reference-parity order")
     args = parser.parse_args()
 
     import jax
@@ -60,6 +64,7 @@ def main() -> int:
         "min_data_in_leaf": "100",
         "min_sum_hessian_in_leaf": "10.0",
         "learning_rate": "0.1",
+        "grow_policy": args.grow_policy,
         "num_iterations": str(args.warmup + args.iters),
     }, require_data=False)
 

@@ -15,7 +15,26 @@ Public surface:
 """
 from __future__ import annotations
 
+import os
+
 __version__ = "0.1.0"
+
+# Persistent XLA compilation cache: the unrolled tree-grower programs take
+# minutes to compile; caching makes every process after the first start hot.
+# TPU-only — CPU AOT artifacts are host-feature-specific and a cache shared
+# across heterogeneous hosts can SIGILL.
+try:  # pragma: no cover - environment dependent
+    import jax
+
+    if (jax.config.jax_compilation_cache_dir is None
+            and "cpu" not in os.environ.get("JAX_PLATFORMS", "").lower()):
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.environ.get("LIGHTGBM_TPU_CACHE",
+                           os.path.expanduser("~/.cache/lightgbm_tpu_xla")))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+except Exception:
+    pass
 
 from .config import OverallConfig, load_config
 from .io.dataset import Dataset

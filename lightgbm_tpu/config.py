@@ -235,6 +235,10 @@ class TreeConfig:
     feature_fraction: float = 1.0
     histogram_pool_size: float = -1.0
     max_depth: int = -1
+    # TPU-native extension (no reference equivalent): "leafwise" reproduces
+    # the reference's strict best-first growth (serial_tree_learner.cpp:119-153);
+    # "depthwise" grows level-batched for MXU throughput (grower_depthwise.py)
+    grow_policy: str = "leafwise"
 
     def set(self, params: Dict[str, str]) -> None:
         self.min_data_in_leaf = _get_int(params, "min_data_in_leaf", self.min_data_in_leaf)
@@ -254,6 +258,11 @@ class TreeConfig:
         self.max_depth = _get_int(params, "max_depth", self.max_depth)
         log.check(self.max_depth > 1 or self.max_depth < 0,
                   "max_depth should be > 1 or < 0")
+        if "grow_policy" in params:
+            value = params["grow_policy"].lower()
+            log.check(value in ("leafwise", "depthwise"),
+                      "grow_policy must be leafwise or depthwise")
+            self.grow_policy = value
 
 
 @dataclasses.dataclass

@@ -25,6 +25,8 @@ import jax.numpy as jnp
 
 # transient one-hot working-set budget (bytes) for the chunked matmul
 CHUNK_BYTE_BUDGET = 256 << 20
+# virtual (pre-tiling) one-hot budget for the leaf-batched kernel
+LEAFBATCH_VIRTUAL_BUDGET = 8 << 30
 
 
 def histogram_matmul(bins: jax.Array, grad: jax.Array, hess: jax.Array,
@@ -94,6 +96,78 @@ def _onehot_chunk(bins_chunk: jax.Array, vals_chunk: jax.Array, B: int,
     out = jnp.dot(vals_chunk.T, flat,
                   preferred_element_type=jnp.float32)  # [3, F*B]
     return out.reshape(3, F, B).transpose(1, 2, 0).astype(compute_dtype)
+
+
+def histogram_leafbatch(bins: jax.Array, grad: jax.Array, hess: jax.Array,
+                        col_id: jax.Array, col_ok: jax.Array, num_cols: int,
+                        num_bins_max: int, chunk: int = 262144,
+                        compute_dtype=jnp.bfloat16) -> jax.Array:
+    """Build histograms for MANY leaves in ONE matmul pass.
+
+    The single-leaf one-hot matmul starves the MXU: the value operand has
+    only 3 columns (grad/hess/count) of a 128-wide tile.  Batching C leaves
+    widens it to 3·C columns, so one pass over the data builds C histograms
+    for (measured) roughly the cost of one — the enabler for the depthwise
+    grower, which needs all leaves of a tree level at once instead of the
+    reference's one-leaf-at-a-time rebuild (serial_tree_learner.cpp:262-283).
+
+    Parameters
+    ----------
+    bins : [F, N] integer bin matrix
+    grad, hess : [N] f32
+    col_id : [N] i32 — histogram column (leaf slot) per row
+    col_ok : [N] bool — row participates (bagging mask ∧ slot-is-active)
+    num_cols : static C — number of histogram columns
+
+    Returns
+    -------
+    hist : [C, F, B, 3] f32
+    """
+    F, N = bins.shape
+    B = num_bins_max
+    # keep the value operand >= ~126 columns so the MXU tile is full even
+    # for small levels (cols are zero-padded; wasted cols are free compared
+    # to a starved tile)
+    C = max(num_cols, 42)
+    okf = col_ok.astype(jnp.float32)
+    vals = jnp.stack([grad.astype(jnp.float32) * okf,
+                      hess.astype(jnp.float32) * okf,
+                      okf], axis=1)  # [N, 3]
+
+    # big chunks amortize per-scan-iteration launch overhead; small inputs
+    # use a single chunk of their own (padded) size.  XLA tiles the one-hot
+    # einsum operand rather than materializing [F, chunk, B] (validated at
+    # 7.5 GB virtual on a 16 GB chip), but clamp the virtual size anyway so
+    # very wide datasets degrade to smaller chunks instead of risking OOM.
+    itemsize = jnp.dtype(compute_dtype).itemsize
+    budget_rows = max(LEAFBATCH_VIRTUAL_BUDGET // (F * B * itemsize), 256)
+    chunk = min(chunk, -(-budget_rows // 256) * 256)
+    chunk = min(chunk, max(256, -(-N // 256) * 256))
+    pad = (-N) % chunk
+    if pad:
+        bins = jnp.pad(bins, ((0, 0), (0, pad)))
+        vals = jnp.pad(vals, ((0, pad), (0, 0)))
+        col_id = jnp.pad(col_id, (0, pad), constant_values=-1)
+    n_chunks = (N + pad) // chunk
+    bins_c = bins.astype(jnp.int32).reshape(F, n_chunks, chunk).transpose(1, 0, 2)
+    vals_c = vals.astype(compute_dtype).reshape(n_chunks, chunk, 3)
+    cid_c = col_id.astype(jnp.int32).reshape(n_chunks, chunk)
+    ib = jnp.arange(B, dtype=jnp.int32)
+    ic = jnp.arange(C, dtype=jnp.int32)
+
+    def body(carry, xs):
+        bc, vc, cc = xs
+        oh = (bc[:, :, None] == ib).astype(compute_dtype)        # [F, C_rows, B]
+        lsel = (cc[:, None] == ic).astype(compute_dtype)         # [C_rows, C]
+        vL = (lsel[:, :, None] * vc[:, None, :]).reshape(chunk, C * 3)
+        out = jnp.einsum("fcb,ck->fbk", oh, vL,
+                         preferred_element_type=jnp.float32)     # [F, B, 3C]
+        return carry + out, None
+
+    init = jnp.zeros((F, B, C * 3), jnp.float32)
+    hist, _ = jax.lax.scan(body, init, (bins_c, vals_c, cid_c))
+    hist = hist.reshape(F, B, C, 3).transpose(2, 0, 1, 3)        # [C, F, B, 3]
+    return hist[:num_cols]
 
 
 def histogram_segsum(bins: jax.Array, grad: jax.Array, hess: jax.Array,

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..models.grower import TreeArrays, grow_tree_impl
+from ..models.grower_depthwise import grow_tree_depthwise
 from ..models.gbdt import _effective_num_leaves
 from ..ops.split import SplitResult, find_best_split
 from ..io.binning import BinMapper
@@ -95,6 +96,11 @@ class _ParallelLearnerBase:
             min_sum_hessian_in_leaf=self.tree_config.min_sum_hessian_in_leaf,
             max_depth=self.tree_config.max_depth)
 
+    @property
+    def _depthwise(self) -> bool:
+        return getattr(self.tree_config, "grow_policy",
+                       "leafwise") == "depthwise"
+
 
 class DataParallelLearner(_ParallelLearnerBase):
     """Rows sharded; histograms psum'd (data_parallel_tree_learner.cpp)."""
@@ -112,9 +118,10 @@ class DataParallelLearner(_ParallelLearnerBase):
 
         if self._jitted is None:
             kwargs = self._grow_kwargs(gbdt)
+            grow = grow_tree_depthwise if self._depthwise else grow_tree_impl
 
             def shard_fn(bins_s, grad_s, hess_s, mask_s, fmask, nbins):
-                return grow_tree_impl(
+                return grow(
                     bins_s, grad_s, hess_s, mask_s, fmask, nbins,
                     hist_reduce=lambda h: jax.lax.psum(h, DATA_AXIS),
                     stat_reduce=lambda s: jax.lax.psum(s, DATA_AXIS),
@@ -154,6 +161,7 @@ class FeatureParallelLearner(_ParallelLearnerBase):
 
         if self._jitted is None:
             kwargs = self._grow_kwargs(gbdt)
+            grow = grow_tree_depthwise if self._depthwise else grow_tree_impl
 
             def shard_fn(bins_full, grad_s, hess_s, mask_s, fmask_pad,
                          nbins_pad):
@@ -172,7 +180,7 @@ class FeatureParallelLearner(_ParallelLearnerBase):
                         feature=(local.feature + offset).astype(jnp.int32))
                     return allreduce_best_split(local, FEATURE_AXIS)
 
-                return grow_tree_impl(
+                return grow(
                     bins_own, grad_s, hess_s, mask_s, fmask_own, nbins_own,
                     split_finder=finder, partition_bins=bins_full, **kwargs)
 
