@@ -90,6 +90,10 @@ class GBDT:
         self._use_bagging = (boosting_config.bagging_fraction < 1.0
                              and boosting_config.bagging_freq > 0)
         self._bag_mask = np.ones(N, dtype=bool)
+        # device-side mask caches: uploads pay full link latency, so only
+        # re-upload when the host-side mask actually changes
+        self._bag_mask_device = jnp.asarray(self._bag_mask)
+        self._feat_mask_device = {}
         # per-class feature-fraction RNGs, same seed each
         # (serial_tree_learner.cpp:159-167; one learner per class)
         self._feat_rngs = [np.random.RandomState(self.tree_config.feature_fraction_seed)
@@ -143,6 +147,7 @@ class GBDT:
             bag_cnt = int(mask.sum())
         log.info("re-bagging, using %d data to train" % bag_cnt)
         self._bag_mask = mask
+        self._bag_mask_device = jnp.asarray(mask)
 
     def _feature_sample(self, cls: int) -> np.ndarray:
         frac = self.tree_config.feature_fraction
@@ -166,16 +171,60 @@ class GBDT:
         for cls in range(self.num_class):
             self._bagging(self.iter)
             feature_mask = self._feature_sample(cls)
-            row_mask = jnp.asarray(self._bag_mask)
+            row_mask = self._bag_mask_device
+            key = feature_mask.tobytes()
+            if key not in self._feat_mask_device:
+                self._feat_mask_device.clear()  # one live entry per class mix
+                self._feat_mask_device[key] = jnp.asarray(feature_mask)
 
             tree_arrays = self._learner(
                 self, self.bins_device, grad[cls], hess[cls], row_mask,
-                jnp.asarray(feature_mask))
+                self._feat_mask_device[key])
 
             # ONE host round-trip for everything the host needs (each
             # device_get pays full tunnel latency; fetching the 8 small
-            # arrays separately costs ~0.5s/tree on a tunneled TPU)
-            host = jax.device_get(tree_arrays._replace(leaf_ids=None))
+            # arrays separately costs ~0.5s/tree on a tunneled TPU).  Start
+            # the copy asynchronously, dispatch the device-side score update
+            # first, and only then block — the link latency overlaps with
+            # device compute.
+            small = tree_arrays._replace(leaf_ids=None)
+            try:
+                for arr in jax.tree.leaves(small):
+                    arr.copy_to_host_async()
+            except Exception:
+                pass
+
+            # train score via leaf partition (fast path, gbdt.cpp:216-218 +
+            # OOB, 159-165 — unified because leaf_ids cover all rows); the
+            # shrinkage (gbdt.cpp:188) is applied on device, so this needs
+            # nothing from the host
+            lr = jnp.float32(self.gbdt_config.learning_rate)
+            # zero the contribution of a degenerate (unsplit) tree on device:
+            # the reference rejects such trees before any score update
+            # (gbdt.cpp:182-185), and this keeps that invariant without
+            # waiting for num_leaves on the host
+            shrunk = jnp.where(tree_arrays.num_leaves > 1,
+                               tree_arrays.leaf_value * lr, 0.0)
+            self.score = self.score.at[cls].add(shrunk[tree_arrays.leaf_ids])
+            # valid scores via tree replay (gbdt.cpp:220-222); the grower's
+            # arrays are already statically padded to num_leaves-1, so the
+            # replay jit compiles once and uses no host data
+            if self.valid_datasets:
+                max_nodes = len(tree_arrays.split_feature)
+                for entry in self.valid_datasets:
+                    entry["score"] = entry["score"].at[cls].set(
+                        add_tree_score(
+                            entry["bins"], entry["score"][cls],
+                            tree_arrays.split_feature,
+                            tree_arrays.threshold_bin,
+                            tree_arrays.left_child,
+                            tree_arrays.right_child,
+                            shrunk,
+                            tree_arrays.num_leaves,
+                            max_nodes=max_nodes))
+
+            # now block on the (already in-flight) host copy for the model
+            host = jax.device_get(small)
             num_leaves = int(host.num_leaves)
             if num_leaves <= 1:
                 log.info("Can't training anymore, there isn't any leaf meets "
@@ -184,35 +233,6 @@ class GBDT:
 
             tree = self._to_host_tree(host)
             tree.shrinkage(self.gbdt_config.learning_rate)
-            # train score via leaf partition (fast path, gbdt.cpp:216-218 +
-            # OOB, 159-165 — unified because leaf_ids cover all rows)
-            leaf_values = jnp.asarray(tree.leaf_value, jnp.float32)
-            self.score = self.score.at[cls].add(
-                leaf_values[tree_arrays.leaf_ids])
-            # valid scores via tree replay (gbdt.cpp:220-222); node arrays
-            # are padded to the static num_leaves-1 so add_tree_score
-            # compiles exactly once regardless of each tree's actual size
-            if self.valid_datasets:
-                max_nodes = max(_effective_num_leaves(self.tree_config) - 1, 1)
-
-                def pad_nodes(arr, fill=0):
-                    out = np.full(max_nodes, fill, dtype=np.asarray(arr).dtype)
-                    out[:len(arr)] = arr
-                    return jnp.asarray(out)
-
-                leaf_vals = np.zeros(max_nodes + 1, dtype=np.float32)
-                leaf_vals[:tree.num_leaves] = tree.leaf_value
-                for entry in self.valid_datasets:
-                    entry["score"] = entry["score"].at[cls].set(
-                        add_tree_score(
-                            entry["bins"], entry["score"][cls],
-                            pad_nodes(tree.split_feature),
-                            pad_nodes(tree.threshold_bin),
-                            pad_nodes(tree.left_child),
-                            pad_nodes(tree.right_child),
-                            jnp.asarray(leaf_vals),
-                            jnp.asarray(tree.num_leaves),
-                            max_nodes=max_nodes))
             self.models.append(tree)
 
         met_early_stopping = False
