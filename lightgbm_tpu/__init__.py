@@ -73,7 +73,5 @@ def train(params: dict, train_set: Dataset, valid_sets=(), valid_names=None):
                                for t in config.metric_types) if m is not None]
         booster.add_valid_dataset(valid, metrics, name=name)
     is_eval = bool(train_metrics) or bool(valid_sets)
-    for _ in range(config.boosting_config.num_iterations):
-        if booster.train_one_iter(is_eval=is_eval):
-            break
+    booster.run_training(config.boosting_config.num_iterations, is_eval)
     return booster

@@ -111,14 +111,13 @@ class Application:
         is_eval = bool(self.train_metrics) or any(
             m for _, m, _ in self.valid_datas)
         start = time.time()
-        for it in range(self.config.boosting_config.num_iterations):
-            finished = self.boosting.train_one_iter(is_eval=is_eval)
-            self.boosting.save_model_to_file(
-                False, self.config.io_config.output_model)
-            log.info("%f seconds elapsed, finished %d iteration"
-                     % (time.time() - start, it + 1))
-            if finished:
-                break
+        self.boosting.run_training(
+            self.config.boosting_config.num_iterations, is_eval,
+            save_fn=lambda: self.boosting.save_model_to_file(
+                False, self.config.io_config.output_model),
+            progress_fn=lambda it: log.info(
+                "%f seconds elapsed, finished %d iteration"
+                % (time.time() - start, it)))
         self.boosting.save_model_to_file(
             True, self.config.io_config.output_model)
         log.info("Finished train")

@@ -126,9 +126,16 @@ class GBDT:
 
     # ------------------------------------------------------------- iteration
 
-    def _bagging(self, it: int) -> None:
-        """GBDT::Bagging (gbdt.cpp:106-157): per-record, or per-query when
-        query boundaries exist."""
+    def _draw_bag_mask(self, it: int) -> None:
+        """Host-side bagging draw (GBDT::Bagging, gbdt.cpp:106-157):
+        per-record, or per-query when query boundaries exist.  Updates
+        ``_bag_mask`` only; device upload is the per-iteration path's concern
+        (the chunked path ships masks in one batched transfer).
+
+        Called once per (iteration, class) pair like the reference
+        (Bagging(iter_, curr_class) inside the per-class loop,
+        gbdt.cpp:175-177): on a redraw iteration each class tree gets a
+        fresh draw from the single shared RNG stream."""
         if not self._use_bagging or it % self.gbdt_config.bagging_freq != 0:
             return
         frac = self.gbdt_config.bagging_fraction
@@ -147,7 +154,12 @@ class GBDT:
             bag_cnt = int(mask.sum())
         log.info("re-bagging, using %d data to train" % bag_cnt)
         self._bag_mask = mask
-        self._bag_mask_device = jnp.asarray(mask)
+        self._bag_mask_device = None
+
+    def _bagging(self, it: int) -> None:
+        self._draw_bag_mask(it)
+        if self._bag_mask_device is None:
+            self._bag_mask_device = jnp.asarray(self._bag_mask)
 
     def _feature_sample(self, cls: int) -> np.ndarray:
         frac = self.tree_config.feature_fraction
@@ -247,6 +259,185 @@ class GBDT:
             del self.models[len(self.models)
                             - self.early_stopping_round * self.num_class:]
         return met_early_stopping
+
+    def run_training(self, num_iterations: int, is_eval: bool,
+                     save_fn: Optional[Callable] = None,
+                     chunk_size: int = 8,
+                     progress_fn: Optional[Callable] = None) -> None:
+        """Drive the full training loop (Application::Train,
+        application.cpp:239-257), fusing iterations into device chunks when
+        no per-iteration metric output is needed."""
+        if (is_eval or not self.supports_chunking
+                or num_iterations < chunk_size):
+            # short runs use the per-iteration path: its grower program is
+            # module-jitted (shared across boosters), while a chunk shorter
+            # than chunk_size would waste the surplus iterations it computes
+            for _ in range(num_iterations):
+                finished = self.train_one_iter(is_eval=is_eval)
+                if save_fn is not None:
+                    save_fn()
+                if progress_fn is not None:
+                    progress_fn(self.iter)
+                if finished:
+                    break
+        else:
+            done = 0
+            while done < num_iterations:
+                # always run the full-size chunk program (a shorter tail
+                # chunk would re-trace the scan and pay a second multi-
+                # minute compile); surplus iterations are rolled back
+                stop = self.train_chunk(chunk_size,
+                                        limit=num_iterations - done)
+                if save_fn is not None:
+                    save_fn()
+                if progress_fn is not None:
+                    progress_fn(self.iter)
+                if stop:
+                    break
+                done += chunk_size
+
+    # ------------------------------------------------------- chunked training
+
+    @property
+    def supports_chunking(self) -> bool:
+        """True when fused multi-iteration training applies: serial learner
+        (the parallel learners own their shard_map programs) and no
+        per-iteration metric consumers (valid sets imply eval/early-stop,
+        which need host metric values every iteration)."""
+        return (self._learner is _serial_learner and not self.valid_datasets
+                and self.early_stopping_round <= 0
+                and hasattr(self.objective, "chunk_spec"))
+
+    def train_chunk(self, k: int, limit: int = -1) -> bool:
+        """Run ``k`` boosting iterations as ONE device program.
+
+        The reference pays a host round-trip per split; the per-iteration
+        path above pays several per iteration (gradient dispatch, grow,
+        score update, model readback — each ~100 ms of link latency on a
+        tunneled TPU).  This path lax.scans the whole iteration body —
+        gradients → tree growth → score update — over k iterations, so the
+        host is touched ONCE per chunk: upload of the per-iteration
+        bagging/feature masks, readback of the k stacked tree arrays.
+
+        Semantics match k calls of train_one_iter(is_eval=False) exactly
+        (same RNG draws for bagging/feature sampling, same degenerate-tree
+        stop: training truncates at the first tree with <= 1 leaf).
+        Returns True when training must stop.
+
+        ``limit`` < k keeps only the first ``limit`` iterations and rolls
+        the RNG streams and score back to that point — used by run_training
+        to serve a short tail with the full-size compiled program instead of
+        re-compiling a second program for the remainder.
+        """
+        has_bag = self._use_bagging
+        has_ff = self.tree_config.feature_fraction < 1.0
+        obj_key, obj_params, grad_fn = self.objective.chunk_spec()
+        fn = _get_chunk_program(
+            obj_key, grad_fn, self.num_class,
+            float(self.gbdt_config.learning_rate),
+            getattr(self.tree_config, "grow_policy", "leafwise"),
+            num_leaves=_effective_num_leaves(self.tree_config),
+            num_bins_max=self.num_bins_max,
+            min_data_in_leaf=self.tree_config.min_data_in_leaf,
+            min_sum_hessian_in_leaf=self.tree_config.min_sum_hessian_in_leaf,
+            max_depth=self.tree_config.max_depth,
+            has_bag=has_bag, has_ff=has_ff)
+
+        C, N, F = self.num_class, self.num_data, self.num_features
+        # snapshots for the (rare) degenerate-tree stop: training must then
+        # look exactly like it stopped at that iteration — RNG streams and
+        # score included
+        bag_state = self._bag_rng.get_state() if has_bag else None
+        ff_states = ([r.get_state() for r in self._feat_rngs]
+                     if has_ff else None)
+        score_before = self.score
+
+        if has_bag:
+            rms = np.empty((k, C, N), dtype=bool)
+            for i in range(k):
+                for cls in range(C):
+                    self._draw_bag_mask(self.iter + i)
+                    rms[i, cls] = self._bag_mask
+            row_masks = jnp.asarray(rms)
+        else:
+            row_masks = jnp.zeros((k, 1), jnp.bool_)   # scan driver only
+        if has_ff:
+            fms = np.empty((k, C, F), dtype=bool)
+            for i in range(k):
+                for cls in range(C):
+                    fms[i, cls] = self._feature_sample(cls)
+            feat_masks = jnp.asarray(fms)
+        else:
+            feat_masks = jnp.zeros((k, 1), jnp.bool_)
+
+        self.score, stacked = fn(self.score, self.bins_device,
+                                 self.num_bins_device, row_masks, feat_masks,
+                                 obj_params)
+        host = jax.device_get(stacked)
+
+        keep_iters = k if limit < 0 else min(k, limit)
+        for i in range(keep_iters):
+            for cls in range(C):
+                sub = jax.tree.map(lambda a: a[i, cls], host)
+                if int(sub.num_leaves) <= 1:
+                    log.info("Can't training anymore, there isn't any leaf "
+                             "meets split requirements.")
+                    # the degenerate pair consumed its RNG draws but
+                    # produced no tree
+                    self._rollback_chunk(i * C + cls + 1, i * C + cls,
+                                         bag_state, ff_states, score_before)
+                    self.iter += i
+                    return True
+                tree = self._to_host_tree(sub)
+                tree.shrinkage(self.gbdt_config.learning_rate)
+                self.models.append(tree)
+        if keep_iters < k:
+            self._rollback_chunk(keep_iters * C, keep_iters * C,
+                                 bag_state, ff_states, score_before)
+        self.iter += keep_iters
+        return False
+
+    def _rollback_chunk(self, replay_pairs: int, kept_trees: int,
+                        bag_state, ff_states, score_before) -> None:
+        """Restore exact per-iteration semantics after a chunk that kept
+        fewer iterations than it ran (mid-chunk degenerate-tree stop, or a
+        run_training tail served by the full-size program): rewind the
+        bagging/feature RNG streams and replay exactly ``replay_pairs``
+        (iteration, class) draws, and rebuild the score from the pre-chunk
+        score plus this chunk's ``kept_trees`` trees (the scan had already
+        applied the discarded iterations' updates on device)."""
+        C = self.num_class
+        if bag_state is not None:
+            self._bag_rng.set_state(bag_state)
+            for p in range(replay_pairs):
+                self._draw_bag_mask(self.iter + p // C)
+        if ff_states is not None:
+            for r, s in zip(self._feat_rngs, ff_states):
+                r.set_state(s)
+            for p in range(replay_pairs):
+                self._feature_sample(p % C)
+
+        kept = self.models[len(self.models) - kept_trees:] \
+            if kept_trees > 0 else []
+        score = score_before
+        max_nodes = max(_effective_num_leaves(self.tree_config) - 1, 1)
+        for m, tree in enumerate(kept):
+            cls_m = m % C
+            pad = lambda a, fill=0: np.pad(
+                np.asarray(a), (0, max_nodes - len(a)),
+                constant_values=fill)
+            leaf_vals = np.zeros(max_nodes + 1, np.float32)
+            leaf_vals[:tree.num_leaves] = tree.leaf_value
+            score = score.at[cls_m].set(add_tree_score(
+                self.bins_device, score[cls_m],
+                jnp.asarray(pad(tree.split_feature)),
+                jnp.asarray(pad(tree.threshold_bin)),
+                jnp.asarray(pad(tree.left_child)),
+                jnp.asarray(pad(tree.right_child)),
+                jnp.asarray(leaf_vals),
+                jnp.asarray(tree.num_leaves),
+                max_nodes=max_nodes))
+        self.score = score
 
     def _to_host_tree(self, host) -> Tree:
         """Build the host Tree from an already-device_get'd TreeArrays."""
@@ -449,6 +640,65 @@ class GBDT:
         for cnt, name in pairs:
             out.append(f"{name}={cnt}")
         return "\n".join(out) + "\n"
+
+
+# Compiled k-iteration chunk programs, shared process-wide.  Keyed ONLY on
+# hashable statics — per-dataset arrays (labels, weights, bins) enter as
+# runtime inputs via obj_params, so the traced HLO is data-independent and a
+# cross-validation loop or repeated lgb.train calls re-use one compile (and
+# the persistent XLA cache can hit across processes).
+_CHUNK_PROGRAMS: dict = {}
+
+
+def _get_chunk_program(obj_key, grad_fn, num_class: int, lr: float,
+                       grow_policy: str, *, num_leaves: int,
+                       num_bins_max: int, min_data_in_leaf: int,
+                       min_sum_hessian_in_leaf: float, max_depth: int,
+                       has_bag: bool, has_ff: bool):
+    key = (obj_key, id(grad_fn), num_class, lr, grow_policy, num_leaves,
+           num_bins_max, min_data_in_leaf, min_sum_hessian_in_leaf,
+           max_depth, has_bag, has_ff)
+    prog = _CHUNK_PROGRAMS.get(key)
+    if prog is not None:
+        return prog
+
+    grower_kwargs = dict(
+        num_leaves=num_leaves, num_bins_max=num_bins_max,
+        min_data_in_leaf=min_data_in_leaf,
+        min_sum_hessian_in_leaf=min_sum_hessian_in_leaf, max_depth=max_depth)
+    if grow_policy == "depthwise":
+        from .grower_depthwise import grow_tree_depthwise as grow
+    else:
+        from .grower import grow_tree_impl as grow
+    lrf = jnp.float32(lr)
+
+    def chunk_fn(score, bins, num_bins, row_masks, feat_masks, obj_params):
+        F, N = bins.shape
+
+        def body(score, xs):
+            rmask, fmask = xs
+            grad, hess = grad_fn(obj_params,
+                                 score if num_class > 1 else score[0])
+            if num_class == 1:
+                grad, hess = grad[None], hess[None]
+            outs = []
+            for cls in range(num_class):
+                rm = rmask[cls] if has_bag else jnp.ones((N,), jnp.bool_)
+                fm = fmask[cls] if has_ff else jnp.ones((F,), jnp.bool_)
+                ta = grow(bins, grad[cls], hess[cls], rm, fm, num_bins,
+                          **grower_kwargs)
+                shrunk = jnp.where(ta.num_leaves > 1,
+                                   ta.leaf_value * lrf, 0.0)
+                score = score.at[cls].add(shrunk[ta.leaf_ids])
+                outs.append(ta._replace(leaf_ids=jnp.zeros((0,), jnp.int32)))
+            stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *outs)
+            return score, stacked
+
+        return jax.lax.scan(body, score, (row_masks, feat_masks))
+
+    prog = jax.jit(chunk_fn)
+    _CHUNK_PROGRAMS[key] = prog
+    return prog
 
 
 def _serial_learner(gbdt: GBDT, bins, grad, hess, row_mask, feature_mask):

@@ -62,7 +62,7 @@ def grow_tree_depthwise(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                         min_sum_hessian_in_leaf: float, max_depth: int = -1,
                         hist_chunk: int = 262144, hist_reduce=None,
                         stat_reduce=None, split_finder=None,
-                        partition_bins=None,
+                        partition_bins=None, compact_rows: bool = True,
                         compute_dtype=jnp.float32) -> TreeArrays:
     """Grow one depth-wise tree.  Output contract == grow_tree_impl's
     TreeArrays (models/grower.py), so boosting/serialization/prediction are
@@ -88,13 +88,21 @@ def grow_tree_depthwise(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     maskf = row_mask.astype(f32)
     mind = float(min_data_in_leaf)
     minh = float(min_sum_hessian_in_leaf)
+    # a stat_reduce hook means rows are sharded (data-parallel): the global
+    # smaller-child choice then voids the local N/2 compaction bound, so
+    # compaction is structurally incompatible — force it off
+    compact_rows = compact_rows and stat_reduce is None
+
+    def batch_hist_rows(b, g, h, col_id, col_ok, C):
+        out = histogram_leafbatch(b, g, h, col_id, col_ok, C, B,
+                                  chunk=hist_chunk,
+                                  compute_dtype=compute_dtype)
+        if hist_reduce is not None:
+            out = hist_reduce(out)
+        return out
 
     def batch_hist(col_id, col_ok, C):
-        h = histogram_leafbatch(bins, grad, hess, col_id, col_ok, C, B,
-                                chunk=hist_chunk, compute_dtype=compute_dtype)
-        if hist_reduce is not None:
-            h = hist_reduce(h)
-        return h
+        return batch_hist_rows(bins, grad, hess, col_id, col_ok, C)
 
     vsplit = jax.vmap(split_finder or find_best_split,
                       in_axes=(0, 0, 0, 0, None, None, None, None))
@@ -216,16 +224,59 @@ def grow_tree_depthwise(bins: jax.Array, grad: jax.Array, hess: jax.Array,
 
         # ---- level histogram: build ONLY the smaller child of every chosen
         # parent in one batched pass, derive the sibling by subtraction
-        small_is_right = res.right_count < res.left_count       # ties → left
         child_parity = slot_id % 2                              # 0=left
         par_of_row = slot_id // 2
+        # smaller-child choice from EXACT int32 row counts, not the f32
+        # histogram counts (whose rounding above ~2^24 rows per parent could
+        # mis-order near-equal children and overflow the N/2 compaction
+        # buffer below); int32 is exact and the tie rule (ties → left)
+        # keeps Σ_p min(nL, nR) <= N/2
+        onehot_p = par_of_row[None, :] == jnp.arange(P, dtype=i32)[:, None]
+        n_right = jnp.sum((onehot_p & (child_parity == 1)[None, :]
+                           & row_mask[None, :]).astype(i32), axis=1)
+        n_all = jnp.sum((onehot_p & row_mask[None, :]).astype(i32), axis=1)
+        # data-parallel: the choice must be REPLICATED across shards (each
+        # shard histograms the same child set before the psum), so reduce
+        # the counts globally like the root stats
+        if stat_reduce is not None:
+            counts = stat_reduce(jnp.stack([n_right, n_all]))
+            n_right, n_all = counts[0], counts[1]
+        small_is_right = n_right < (n_all - n_right)            # ties → left
         small_sel = jnp.einsum(
             "pn,pn->n",
             ((par_of_row[None, :] == jnp.arange(P, dtype=i32)[:, None])
              & chosen[:, None]).astype(f32),
             (child_parity[None, :] == small_is_right[:, None].astype(i32)
              ).astype(f32)) > 0.5
-        hist_small = batch_hist(par_of_row, small_sel & row_mask, P)
+        # Row compaction: every parent's smaller child holds at most half the
+        # parent's rows, so Σ smaller-child rows <= N/2 ALWAYS — gather the
+        # selected rows into a static [N/2] buffer and run the histogram
+        # matmul on half the data (measured 2x on the level pass, gathers
+        # included).  The reference gets the same effect from its per-leaf
+        # index lists (data_partition.hpp); this is the masked-dense
+        # equivalent.
+        sel = small_sel & row_mask
+        if compact_rows:
+            # The N/2 capacity proof needs smaller-child identity and the
+            # compacted row population to use the SAME counts; under the
+            # data-parallel learner 'smaller' comes from GLOBAL (psum'd)
+            # counts while rows here are the local shard, so a skewed shard
+            # could overflow — that learner passes compact_rows=False.
+            Nh = (N + 1) // 2
+            pos = jnp.cumsum(sel.astype(i32)) - 1
+            tgt = jnp.where(sel, pos, BIG)
+            gidx = jnp.zeros((Nh,), i32).at[tgt].set(
+                jnp.arange(N, dtype=i32), mode="drop")
+            hvalid = jnp.arange(Nh, dtype=i32) <= pos[-1]
+            # one fused gather for grad/hess/slot (slot rides as bitcast f32)
+            packed = jnp.stack([grad, hess, jax.lax.bitcast_convert_type(
+                par_of_row, jnp.float32)])
+            pk = jnp.take(packed, gidx, axis=1)                   # [3, Nh]
+            par_h = jax.lax.bitcast_convert_type(pk[2], i32)
+            hist_small = batch_hist_rows(
+                jnp.take(bins, gidx, axis=1), pk[0], pk[1], par_h, hvalid, P)
+        else:
+            hist_small = batch_hist(par_of_row, sel, P)
         hist_large = hists - hist_small
         hsmall_slot = interleave(jnp.where(small_is_right[:, None, None, None],
                                            hist_large, hist_small),
@@ -253,4 +304,5 @@ def grow_tree_depthwise(bins: jax.Array, grad: jax.Array, hess: jax.Array,
 grow_tree_depthwise_jit = jax.jit(
     grow_tree_depthwise,
     static_argnames=("num_leaves", "num_bins_max", "min_data_in_leaf",
-                     "min_sum_hessian_in_leaf", "max_depth", "hist_chunk"))
+                     "min_sum_hessian_in_leaf", "max_depth", "hist_chunk",
+                     "compact_rows"))

@@ -38,16 +38,21 @@ class BinaryLogloss:
     def get_gradients(self, score: jax.Array):
         """response = −2·l·σ/(1+exp(2·l·σ·s)); hess = |r|(2σ−|r|)
         (binary_objective.hpp:55-81)."""
-        sig = jnp.float32(self._sigmoid)
-        ls = self.label_sign
-        response = -2.0 * ls * sig / (1.0 + jnp.exp(2.0 * ls * sig * score))
-        abs_response = jnp.abs(response)
-        grad = response * self.label_weight
-        hess = abs_response * (2.0 * sig - abs_response) * self.label_weight
-        if self.weights is not None:
-            grad = grad * self.weights
-            hess = hess * self.weights
-        return grad, hess
+        return _binary_gradients(self.chunk_params(), score)
+
+    def chunk_spec(self):
+        """(key, params, fn) for the fused-chunk trainer: fn is a module-
+        level pure function (dataset state rides in params as runtime
+        inputs), so compiled chunk programs are shared across boosters and
+        datasets of the same shape."""
+        return (("binary", self.weights is not None), self.chunk_params(),
+                _binary_gradients)
+
+    def chunk_params(self):
+        return {"sigmoid": jnp.float32(self._sigmoid),
+                "label_sign": self.label_sign,
+                "label_weight": self.label_weight,
+                "weights": self.weights}
 
     @property
     def sigmoid(self) -> float:
@@ -56,3 +61,16 @@ class BinaryLogloss:
     @property
     def num_class(self) -> int:
         return 1
+
+
+def _binary_gradients(params, score):
+    sig = params["sigmoid"]
+    ls = params["label_sign"]
+    response = -2.0 * ls * sig / (1.0 + jnp.exp(2.0 * ls * sig * score))
+    abs_response = jnp.abs(response)
+    grad = response * params["label_weight"]
+    hess = abs_response * (2.0 * sig - abs_response) * params["label_weight"]
+    if params["weights"] is not None:
+        grad = grad * params["weights"]
+        hess = hess * params["weights"]
+    return grad, hess

@@ -26,13 +26,14 @@ class MulticlassLogloss:
     def get_gradients(self, score: jax.Array):
         """score layout [K, N]; softmax per row; grad = p − 1[y=k],
         hess = 2p(1−p) (multiclass_objective.hpp:37-75)."""
-        p = jax.nn.softmax(score.astype(jnp.float32), axis=0)  # [K, N]
-        grad = p - self.onehot.T
-        hess = 2.0 * p * (1.0 - p)
-        if self.weights is not None:
-            grad = grad * self.weights[None, :]
-            hess = hess * self.weights[None, :]
-        return grad, hess
+        return _multiclass_gradients(self.chunk_params(), score)
+
+    def chunk_spec(self):
+        return (("multiclass", self._num_class, self.weights is not None),
+                self.chunk_params(), _multiclass_gradients)
+
+    def chunk_params(self):
+        return {"onehot": self.onehot, "weights": self.weights}
 
     @property
     def sigmoid(self) -> float:
@@ -41,3 +42,13 @@ class MulticlassLogloss:
     @property
     def num_class(self) -> int:
         return self._num_class
+
+
+def _multiclass_gradients(params, score):
+    p = jax.nn.softmax(score.astype(jnp.float32), axis=0)  # [K, N]
+    grad = p - params["onehot"].T
+    hess = 2.0 * p * (1.0 - p)
+    if params["weights"] is not None:
+        grad = grad * params["weights"][None, :]
+        hess = hess * params["weights"][None, :]
+    return grad, hess

@@ -80,14 +80,24 @@ class LambdarankNDCG:
         self.block = max(1, min(nq, (1 << 24) // max(qmax * qmax, 1)))
 
     def get_gradients(self, score: jax.Array):
-        lambdas, hessians = _lambdarank_grads(
-            score.astype(jnp.float32), self.doc_index, self.valid,
-            self.labels_padded, self.inv_max_dcg, self.discount, self.gains,
-            jnp.float32(self._sigmoid), self.num_data, self.block)
-        if self.weights is not None:
-            lambdas = lambdas * self.weights
-            hessians = hessians * self.weights
-        return lambdas, hessians
+        _, params, fn = self.chunk_spec()
+        return fn(params, score)
+
+    def chunk_spec(self):
+        # num_data/block are static (they shape the padded query blocks);
+        # they key the cached chunk program
+        fn = functools.partial(_rank_gradients, num_data=self.num_data,
+                               block=self.block)
+        key = ("lambdarank", self.num_data, self.block, self.qmax, self.nq,
+               self.weights is not None)
+        return key, self.chunk_params(), _RANK_FNS.setdefault(key, fn)
+
+    def chunk_params(self):
+        return {"doc_index": self.doc_index, "valid": self.valid,
+                "labels": self.labels_padded, "inv_max_dcg": self.inv_max_dcg,
+                "discount": self.discount, "gains": self.gains,
+                "sigmoid": jnp.float32(self._sigmoid),
+                "weights": self.weights}
 
     @property
     def sigmoid(self) -> float:
@@ -97,6 +107,22 @@ class LambdarankNDCG:
     @property
     def num_class(self) -> int:
         return 1
+
+
+# one callable per static key so the chunk trainer's program cache can use
+# function identity (a fresh functools.partial per call would defeat it)
+_RANK_FNS: dict = {}
+
+
+def _rank_gradients(params, score, *, num_data: int, block: int):
+    lambdas, hessians = _lambdarank_grads(
+        score.astype(jnp.float32), params["doc_index"], params["valid"],
+        params["labels"], params["inv_max_dcg"], params["discount"],
+        params["gains"], params["sigmoid"], num_data, block)
+    if params["weights"] is not None:
+        lambdas = lambdas * params["weights"]
+        hessians = hessians * params["weights"]
+    return lambdas, hessians
 
 
 @functools.partial(jax.jit, static_argnames=("num_data", "block"))

@@ -17,12 +17,14 @@ class RegressionL2Loss:
     def get_gradients(self, score: jax.Array):
         """grad = score − label, hess = 1 (×weight)
         (regression_objective.hpp:24-39)."""
-        grad = score.astype(jnp.float32) - self.label
-        hess = jnp.ones_like(grad)
-        if self.weights is not None:
-            grad = grad * self.weights
-            hess = hess * self.weights
-        return grad, hess
+        return _regression_gradients(self.chunk_params(), score)
+
+    def chunk_spec(self):
+        return (("regression", self.weights is not None),
+                self.chunk_params(), _regression_gradients)
+
+    def chunk_params(self):
+        return {"label": self.label, "weights": self.weights}
 
     @property
     def sigmoid(self) -> float:
@@ -31,3 +33,12 @@ class RegressionL2Loss:
     @property
     def num_class(self) -> int:
         return 1
+
+
+def _regression_gradients(params, score):
+    grad = score.astype(jnp.float32) - params["label"]
+    hess = jnp.ones_like(grad)
+    if params["weights"] is not None:
+        grad = grad * params["weights"]
+        hess = hess * params["weights"]
+    return grad, hess

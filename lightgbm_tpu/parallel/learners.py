@@ -120,6 +120,11 @@ class DataParallelLearner(_ParallelLearnerBase):
             kwargs = self._grow_kwargs(gbdt)
             grow = grow_tree_depthwise if self._depthwise else grow_tree_impl
 
+            if self._depthwise:
+                # global smaller-child choice vs local shard rows breaks the
+                # N/2 compaction capacity proof (see grow_tree_depthwise)
+                kwargs = dict(kwargs, compact_rows=False)
+
             def shard_fn(bins_s, grad_s, hess_s, mask_s, fmask, nbins):
                 return grow(
                     bins_s, grad_s, hess_s, mask_s, fmask, nbins,

@@ -140,3 +140,200 @@ def test_continued_training_via_init_score(synthetic_binary):
     ll1 = -np.mean(y * np.log(np.clip(prob1, 1e-9, 1))
                    + (1 - y) * np.log(np.clip(1 - prob1, 1e-9, 1)))
     assert ll < ll1
+
+
+def test_chunked_training_matches_per_iter(synthetic_binary):
+    """train_chunk(k) must reproduce k train_one_iter calls exactly: same
+    trees, same scores, same RNG stream for bagging/feature sampling."""
+    x, y = synthetic_binary
+    params = dict(BASE, num_iterations=6, metric="",
+                  bagging_fraction=0.7, bagging_freq=2, bagging_seed=3,
+                  feature_fraction=0.6)
+    del params["metric"]
+    ds = Dataset.from_arrays(x, y, max_bin=64)
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.config import OverallConfig
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.objectives import create_objective
+
+    def make():
+        cfg = OverallConfig()
+        cfg.set({k: str(v) for k, v in params.items()}, require_data=False)
+        b = GBDT()
+        obj = create_objective(cfg.objective_type, cfg.objective_config)
+        b.init(cfg.boosting_config, ds, obj)
+        return b
+
+    b1 = make()
+    for _ in range(6):
+        b1.train_one_iter(is_eval=False)
+
+    b2 = make()
+    assert b2.supports_chunking
+    stop = b2.train_chunk(4)
+    assert not stop
+    b2.train_chunk(2)
+
+    assert len(b1.models) == len(b2.models) == 6
+    for t1, t2 in zip(b1.models, b2.models):
+        assert t1.num_leaves == t2.num_leaves
+        np.testing.assert_array_equal(t1.split_feature, t2.split_feature)
+        np.testing.assert_array_equal(t1.threshold_bin, t2.threshold_bin)
+        np.testing.assert_allclose(t1.leaf_value, t2.leaf_value,
+                                   rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(b1.score), np.asarray(b2.score),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_chunked_training_depthwise(synthetic_binary):
+    x, y = synthetic_binary
+    params = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 20,
+              "min_sum_hessian_in_leaf": 1.0, "num_iterations": 4,
+              "learning_rate": 0.2, "grow_policy": "depthwise"}
+    ds = Dataset.from_arrays(x, y, max_bin=64)
+    from lightgbm_tpu.config import OverallConfig
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.objectives import create_objective
+
+    def make():
+        cfg = OverallConfig()
+        cfg.set({k: str(v) for k, v in params.items()}, require_data=False)
+        b = GBDT()
+        obj = create_objective(cfg.objective_type, cfg.objective_config)
+        b.init(cfg.boosting_config, ds, obj)
+        return b
+
+    b1 = make()
+    for _ in range(4):
+        b1.train_one_iter(is_eval=False)
+    b2 = make()
+    b2.train_chunk(4)
+    assert len(b1.models) == len(b2.models) == 4
+    for t1, t2 in zip(b1.models, b2.models):
+        np.testing.assert_array_equal(t1.split_feature, t2.split_feature)
+        np.testing.assert_array_equal(t1.threshold_bin, t2.threshold_bin)
+
+
+def test_chunked_training_multiclass(synthetic_binary):
+    x, _ = synthetic_binary
+    rng = np.random.RandomState(5)
+    y = rng.randint(0, 3, size=x.shape[0]).astype(np.float32)
+    params = {"objective": "multiclass", "num_class": 3, "num_leaves": 7,
+              "min_data_in_leaf": 20, "min_sum_hessian_in_leaf": 1.0,
+              "num_iterations": 3, "learning_rate": 0.2}
+    ds = Dataset.from_arrays(x, y, max_bin=32)
+    from lightgbm_tpu.config import OverallConfig
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.objectives import create_objective
+
+    def make():
+        cfg = OverallConfig()
+        cfg.set({k: str(v) for k, v in params.items()}, require_data=False)
+        b = GBDT()
+        obj = create_objective(cfg.objective_type, cfg.objective_config)
+        b.init(cfg.boosting_config, ds, obj)
+        return b
+
+    b1 = make()
+    for _ in range(3):
+        b1.train_one_iter(is_eval=False)
+    b2 = make()
+    b2.train_chunk(3)
+    assert len(b1.models) == len(b2.models) == 9
+    for t1, t2 in zip(b1.models, b2.models):
+        np.testing.assert_array_equal(t1.split_feature, t2.split_feature)
+
+
+def test_chunked_degenerate_stop_matches_per_iter():
+    """A mid-chunk can't-split-anymore stop must leave models, iter, score
+    and RNG streams exactly as the per-iteration path would."""
+    rng = np.random.RandomState(0)
+    n = 60
+    bit = (np.arange(n) % 2).astype(np.float64)       # exactly two values
+    x = np.stack([bit, bit, bit], axis=1)             # every feature fits y
+    y = bit.astype(np.float32)
+    # y IS each feature: with lr=1 the first tree fits it exactly (leaf
+    # outputs are in-bag residual means over constant-y leaves), so every
+    # later tree has all-zero gradients, gain 0, and degenerates ->
+    # mid-chunk stop (feature_fraction can drop any column, they all work)
+    params = {"objective": "regression", "num_leaves": 2,
+              "min_data_in_leaf": 5, "min_sum_hessian_in_leaf": 1e-3,
+              "num_iterations": 8, "learning_rate": 1.0,
+              "bagging_fraction": 0.9, "bagging_freq": 1, "bagging_seed": 1,
+              "feature_fraction": 0.99}
+    ds = Dataset.from_arrays(x, y, max_bin=16)
+    from lightgbm_tpu.config import OverallConfig
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.objectives import create_objective
+
+    def make():
+        cfg = OverallConfig()
+        cfg.set({k: str(v) for k, v in params.items()}, require_data=False)
+        b = GBDT()
+        obj = create_objective(cfg.objective_type, cfg.objective_config)
+        b.init(cfg.boosting_config, ds, obj)
+        return b
+
+    b1 = make()
+    stopped1 = False
+    for _ in range(8):
+        if b1.train_one_iter(is_eval=False):
+            stopped1 = True
+            break
+    b2 = make()
+    stopped2 = b2.train_chunk(8)
+    if not stopped1:
+        pytest.skip("fixture did not produce a degenerate tree")
+    assert stopped2
+    assert b1.iter == b2.iter
+    assert len(b1.models) == len(b2.models)
+    for t1, t2 in zip(b1.models, b2.models):
+        np.testing.assert_array_equal(t1.split_feature, t2.split_feature)
+    np.testing.assert_allclose(np.asarray(b1.score), np.asarray(b2.score),
+                               rtol=1e-4, atol=1e-5)
+    # RNG streams line up for continued training
+    np.testing.assert_array_equal(b1._bag_rng.randint(0, 1 << 30, 5),
+                                  b2._bag_rng.randint(0, 1 << 30, 5))
+
+
+def test_run_training_tail_truncation(synthetic_binary):
+    """num_iterations not divisible by chunk_size: the tail is served by the
+    full-size program and rolled back — models, iter, score and RNG must
+    match the per-iteration path."""
+    x, y = synthetic_binary
+    params = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 20,
+              "min_sum_hessian_in_leaf": 1.0, "num_iterations": 5,
+              "learning_rate": 0.2, "bagging_fraction": 0.8,
+              "bagging_freq": 2, "bagging_seed": 9, "feature_fraction": 0.7}
+    # chunk_size=4 < num_iterations=5 so the chunked branch runs: one full
+    # chunk then a tail chunk(4, limit=1) exercising the rollback path
+    ds = Dataset.from_arrays(x, y, max_bin=64)
+    from lightgbm_tpu.config import OverallConfig
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.objectives import create_objective
+
+    def make():
+        cfg = OverallConfig()
+        cfg.set({k: str(v) for k, v in params.items()}, require_data=False)
+        b = GBDT()
+        obj = create_objective(cfg.objective_type, cfg.objective_config)
+        b.init(cfg.boosting_config, ds, obj)
+        return b
+
+    b1 = make()
+    for _ in range(5):
+        b1.train_one_iter(is_eval=False)
+    b2 = make()
+    b2.run_training(5, is_eval=False, chunk_size=4)
+    assert b1.iter == b2.iter == 5
+    assert len(b1.models) == len(b2.models) == 5
+    for t1, t2 in zip(b1.models, b2.models):
+        np.testing.assert_array_equal(t1.split_feature, t2.split_feature)
+        np.testing.assert_array_equal(t1.threshold_bin, t2.threshold_bin)
+    np.testing.assert_allclose(np.asarray(b1.score), np.asarray(b2.score),
+                               rtol=1e-3, atol=1e-4)
+    np.testing.assert_array_equal(b1._bag_rng.randint(0, 1 << 30, 5),
+                                  b2._bag_rng.randint(0, 1 << 30, 5))
+    np.testing.assert_array_equal(b1._feat_rngs[0].randint(0, 1 << 30, 5),
+                                  b2._feat_rngs[0].randint(0, 1 << 30, 5))
