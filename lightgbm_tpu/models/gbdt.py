@@ -329,6 +329,11 @@ class GBDT:
         to serve a short tail with the full-size compiled program instead of
         re-compiling a second program for the remainder.
         """
+        if not self.supports_chunking:
+            raise RuntimeError(
+                "train_chunk requires the serial learner, no valid "
+                "datasets and no early stopping (see supports_chunking); "
+                "use train_one_iter / run_training instead")
         has_bag = self._use_bagging
         has_ff = self.tree_config.feature_fraction < 1.0
         obj_key, obj_params, grad_fn = self.objective.chunk_spec()

@@ -244,8 +244,7 @@ def grow_tree_depthwise(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         small_is_right = n_right < (n_all - n_right)            # ties → left
         small_sel = jnp.einsum(
             "pn,pn->n",
-            ((par_of_row[None, :] == jnp.arange(P, dtype=i32)[:, None])
-             & chosen[:, None]).astype(f32),
+            (onehot_p & chosen[:, None]).astype(f32),
             (child_parity[None, :] == small_is_right[:, None].astype(i32)
              ).astype(f32)) > 0.5
         # Row compaction: every parent's smaller child holds at most half the
