@@ -257,28 +257,41 @@ class Dataset:
                     weights: Optional[np.ndarray] = None,
                     query_boundaries: Optional[np.ndarray] = None,
                     sample_cnt: int = SAMPLE_CNT,
-                    seed: int = 1) -> "Dataset":
+                    seed: int = 1,
+                    reference: Optional["Dataset"] = None) -> "Dataset":
         """Library entry: build a Dataset from in-memory arrays (no reference
-        analog — the reference is file-only; this is the Python-API path)."""
+        analog — the reference is file-only; this is the Python-API path).
+
+        ``reference``: an existing (training) Dataset whose bin mappers are
+        reused — required for validation sets, which must be quantized with
+        the TRAINING distribution's bins (Dataset::LoadValidationData,
+        dataset.cpp:467-511)."""
         self = cls()
         features = np.asarray(features, dtype=np.float64)
         self.max_bin = max_bin
         self.num_total_features = features.shape[1]
         self.feature_names = [f"Column_{i}" for i in range(features.shape[1])]
         total_rows = features.shape[0]
-        rng = np.random.RandomState(seed)
-        if total_rows > sample_cnt:
-            sample = features[np.sort(rng.choice(total_rows, sample_cnt,
-                                                 replace=False))]
+        if reference is not None:
+            if features.shape[1] != reference.num_total_features:
+                log.fatal("valid data has different number of features")
+            self.max_bin = reference.max_bin
+            self.used_feature_map = dict(reference.used_feature_map)
+            self.bin_mappers = reference.bin_mappers
         else:
-            sample = features
-        for j in range(features.shape[1]):
-            m = BinMapper()
-            m.find_bin(sample[:, j], max_bin)
-            if m.is_trivial:
-                continue
-            self.used_feature_map[j] = len(self.bin_mappers)
-            self.bin_mappers.append(m)
+            rng = np.random.RandomState(seed)
+            if total_rows > sample_cnt:
+                sample = features[np.sort(rng.choice(total_rows, sample_cnt,
+                                                     replace=False))]
+            else:
+                sample = features
+            for j in range(features.shape[1]):
+                m = BinMapper()
+                m.find_bin(sample[:, j], max_bin)
+                if m.is_trivial:
+                    continue
+                self.used_feature_map[j] = len(self.bin_mappers)
+                self.bin_mappers.append(m)
         self.real_feature_idx = np.array(sorted(self.used_feature_map),
                                          dtype=np.int32)
         self.num_bins = np.array([m.num_bin for m in self.bin_mappers],

@@ -26,6 +26,15 @@ class Metric:
     def eval(self, score: np.ndarray) -> List[float]:
         raise NotImplementedError
 
+    def device_spec(self):
+        """(key, params, fn) for in-program evaluation (metrics/device.py),
+        or None when this metric has no device formulation."""
+        return None
+
+    def n_values(self) -> int:
+        """Number of values eval()/device fn produce (NDCG: one per k)."""
+        return 1
+
 
 class _PointwiseMetric(Metric):
     """Weighted-mean pointwise losses (regression_metric.hpp:16-121,
@@ -57,6 +66,13 @@ class _PointwiseMetric(Metric):
     def _point_loss(self, score):
         raise NotImplementedError
 
+    def _device_params(self):
+        import jax.numpy as jnp
+        return {"label": jnp.asarray(self.label, jnp.float32),
+                "weights": (jnp.asarray(self.weights, jnp.float32)
+                            if self.weights is not None else None),
+                "sum_weights": jnp.float32(self.sum_weights)}
+
 
 class L2Metric(_PointwiseMetric):
     loss_name = "l2 loss"
@@ -69,12 +85,22 @@ class L2Metric(_PointwiseMetric):
         # L2 metric reports RMSE (regression_metric.hpp:100-103)
         return float(np.sqrt(mean_loss))
 
+    def device_spec(self):
+        from . import device
+        return (("l2", self.weights is not None), self._device_params(),
+                device.l2_metric)
+
 
 class L1Metric(_PointwiseMetric):
     loss_name = "l1 loss"
 
     def _point_loss(self, score):
         return np.abs(score - self.label)
+
+    def device_spec(self):
+        from . import device
+        return (("l1", self.weights is not None), self._device_params(),
+                device.l1_metric)
 
 
 class _BinaryMetric(_PointwiseMetric):
@@ -98,6 +124,14 @@ class BinaryLoglossMetric(_BinaryMetric):
         prob = np.clip(prob, eps, 1 - eps)
         return np.where(self.label == 1, -np.log(prob), -np.log(1.0 - prob))
 
+    def device_spec(self):
+        import jax.numpy as jnp
+        from . import device
+        params = self._device_params()
+        params["sigmoid"] = jnp.float32(self.sigmoid)
+        return (("binary_logloss", self.weights is not None), params,
+                device.binary_logloss_metric)
+
 
 class BinaryErrorMetric(_BinaryMetric):
     loss_name = "error rate"
@@ -107,6 +141,14 @@ class BinaryErrorMetric(_BinaryMetric):
         # error rate (binary_metric.hpp:131-141): prob>0.5 predicted positive
         pred_pos = prob > 0.5
         return np.where(pred_pos == (self.label == 1), 0.0, 1.0)
+
+    def device_spec(self):
+        import jax.numpy as jnp
+        from . import device
+        params = self._device_params()
+        params["sigmoid"] = jnp.float32(self.sigmoid)
+        return (("binary_error", self.weights is not None), params,
+                device.binary_error_metric)
 
 
 class AUCMetric(Metric):
@@ -146,6 +188,16 @@ class AUCMetric(Metric):
             auc = accum / (sum_pos * (self.sum_weights - sum_pos))
         return [auc]
 
+    def device_spec(self):
+        import jax.numpy as jnp
+        from . import device
+        params = {"label": jnp.asarray(self.label, jnp.float32),
+                  "weights": (jnp.asarray(self.weights, jnp.float32)
+                              if self.weights is not None else None),
+                  "sum_weights": jnp.float32(self.sum_weights)}
+        return (("auc", self.weights is not None), params,
+                device.auc_metric)
+
 
 class _MulticlassMetric(Metric):
     """Score layout [K, N] flattened row-major like the reference's
@@ -154,6 +206,13 @@ class _MulticlassMetric(Metric):
     def __init__(self, config):
         self.num_class = int(config.num_class)
         self.weights = None
+
+    def _device_params(self):
+        import jax.numpy as jnp
+        return {"label": jnp.asarray(self.label, jnp.int32),
+                "weights": (jnp.asarray(self.weights, jnp.float32)
+                            if self.weights is not None else None),
+                "sum_weights": jnp.float32(self.sum_weights)}
 
     def init(self, test_name, metadata, num_data):
         self.name = f"{test_name}'s {self.loss_name}"
@@ -179,6 +238,12 @@ class MultiErrorMetric(_MulticlassMetric):
         pred = np.argmax(score, axis=0)
         return np.where(pred == self.label, 0.0, 1.0)
 
+    def device_spec(self):
+        from . import device
+        return (("multi_error", self.num_class,
+                 self.weights is not None), self._device_params(),
+                device.multi_error_metric)
+
 
 class MultiLoglossMetric(_MulticlassMetric):
     loss_name = "multi logloss"
@@ -191,6 +256,12 @@ class MultiLoglossMetric(_MulticlassMetric):
         picked = np.clip(p[self.label, np.arange(self.num_data)], eps, 1.0)
         return -np.log(picked)
 
+    def device_spec(self):
+        from . import device
+        return (("multi_logloss", self.num_class,
+                 self.weights is not None), self._device_params(),
+                device.multi_logloss_metric)
+
 
 class NDCGMetric(Metric):
     """NDCG@ks (rank_metric.hpp:16-167)."""
@@ -199,6 +270,9 @@ class NDCGMetric(Metric):
     def __init__(self, config):
         self.eval_at = list(config.eval_at)
         self.dcg = DCGCalculator(config.label_gain)
+
+    def n_values(self) -> int:
+        return len(self.eval_at)
 
     def init(self, test_name, metadata, num_data):
         self.name = (f"{test_name}'s "
@@ -238,6 +312,36 @@ class NDCGMetric(Metric):
             for j, d in enumerate(dcgs):
                 result[j] += d * self.inv_max[q][j] * w
         return [float(r / self.sum_query_weights) for r in result]
+
+    def device_spec(self):
+        import jax.numpy as jnp
+        from . import device
+        nq = self.boundaries.size - 1
+        qmax = int(np.diff(self.boundaries).max())
+        doc_index = np.zeros((nq, qmax), dtype=np.int32)
+        valid = np.zeros((nq, qmax), dtype=bool)
+        labels = np.zeros((nq, qmax), dtype=np.int32)
+        for q in range(nq):
+            lo, hi = self.boundaries[q], self.boundaries[q + 1]
+            m = hi - lo
+            doc_index[q, :m] = np.arange(lo, hi)
+            valid[q, :m] = True
+            labels[q, :m] = self.label[lo:hi].astype(np.int32)
+        block = max(1, min(nq, (1 << 22) // max(qmax, 1)))
+        params = {
+            "doc_index": jnp.asarray(doc_index),
+            "valid": jnp.asarray(valid),
+            "labels": jnp.asarray(labels),
+            "inv_max": jnp.asarray(np.asarray(self.inv_max, np.float32)),
+            "gains": jnp.asarray(self.dcg.label_gain, jnp.float32),
+            "discount": jnp.asarray(self.dcg.discount[:qmax], jnp.float32),
+            "query_weights": (jnp.asarray(self.query_weights, jnp.float32)
+                              if self.query_weights is not None else None),
+            "sum_query_weights": jnp.float32(self.sum_query_weights),
+        }
+        ks = tuple(int(k) for k in self.eval_at)
+        return (("ndcg", ks, block, self.query_weights is not None),
+                params, device.ndcg_fn(ks, block))
 
 
 def create_metric(metric_type: str, config) -> Optional[Metric]:

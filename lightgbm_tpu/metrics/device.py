@@ -1,0 +1,180 @@
+"""Device-side (jit-traceable) metric evaluation.
+
+The reference evaluates metrics on CPU threads over host score vectors
+(src/metric/*.hpp with OpenMP).  Here every metric also has a pure-JAX
+formulation so evaluation can run INSIDE the fused multi-iteration training
+program (models/gbdt.py train_chunk): scores never leave the device and the
+CLI's metric-every-iteration cadence costs no extra host round-trips.
+
+Each host metric class (metrics/__init__.py) exposes ``device_spec()``
+returning ``(key, params, fn)``:
+- ``fn(params, score) -> [n_out] f32`` is a module-level pure function
+  (no per-dataset constants), so compiled programs are shared across
+  boosters/datasets of the same shape;
+- ``params`` is a pytree of device arrays (labels, weights, query tables);
+- ``key`` is hashable and pins fn's static behavior for program caching.
+
+``score`` is `[N]` for single-class metrics and `[num_class, N]` for the
+multiclass ones (the device layout; no reference-style flattening).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+# ---------------------------------------------------------------- pointwise
+
+def _weighted_mean(loss, weights, sum_weights):
+    if weights is not None:
+        loss = loss * weights
+    return jnp.sum(loss) / sum_weights
+
+
+def l2_metric(params, score):
+    d = score.astype(jnp.float32) - params["label"]
+    mean = _weighted_mean(d * d, params["weights"], params["sum_weights"])
+    return jnp.sqrt(mean)[None]          # L2 reports RMSE
+
+
+def l1_metric(params, score):
+    d = jnp.abs(score.astype(jnp.float32) - params["label"])
+    return _weighted_mean(d, params["weights"], params["sum_weights"])[None]
+
+
+def _binary_prob(params, score):
+    return 1.0 / (1.0 + jnp.exp(-2.0 * params["sigmoid"]
+                                * score.astype(jnp.float32)))
+
+
+# host metric clips prob to [1e-15, 1-1e-15] (in double); the matching
+# loss ceiling, applied in the log domain where f32 can express it
+# (1 - 1e-15 rounds to 1.0 in f32, which would send -log(1-p) to inf)
+_MAX_LOG_LOSS = 34.538776394910684   # -log(1e-15)
+
+
+def binary_logloss_metric(params, score):
+    x = 2.0 * params["sigmoid"] * score.astype(jnp.float32)
+    # -log(sigmoid(x)) = softplus(-x); -log(1 - sigmoid(x)) = softplus(x)
+    loss = jnp.where(params["label"] == 1, jax.nn.softplus(-x),
+                     jax.nn.softplus(x))
+    loss = jnp.minimum(loss, _MAX_LOG_LOSS)
+    return _weighted_mean(loss, params["weights"],
+                          params["sum_weights"])[None]
+
+
+def binary_error_metric(params, score):
+    pred_pos = _binary_prob(params, score) > 0.5
+    loss = jnp.where(pred_pos == (params["label"] == 1), 0.0, 1.0)
+    return _weighted_mean(loss, params["weights"],
+                          params["sum_weights"])[None]
+
+
+def multi_logloss_metric(params, score):
+    p = jax.nn.softmax(score.astype(jnp.float32), axis=0)      # [K, N]
+    n = score.shape[1]
+    picked = jnp.clip(p[params["label"], jnp.arange(n)], 1e-15, 1.0)
+    return _weighted_mean(-jnp.log(picked), params["weights"],
+                          params["sum_weights"])[None]
+
+
+def multi_error_metric(params, score):
+    pred = jnp.argmax(score, axis=0)
+    loss = jnp.where(pred == params["label"], 0.0, 1.0)
+    return _weighted_mean(loss, params["weights"],
+                          params["sum_weights"])[None]
+
+
+# --------------------------------------------------------------------- AUC
+
+def auc_metric(params, score):
+    """Weighted AUC with tie handling (binary_metric.hpp:184-241): sweep
+    score-descending tie GROUPS, each contributing
+    grp_neg * (0.5*grp_pos + pos_before)."""
+    score = score.astype(jnp.float32)
+    label = params["label"]
+    w = params["weights"]
+    n = score.shape[0]
+    wt = jnp.ones_like(score) if w is None else w
+    order = jnp.argsort(-score, stable=True)
+    s = score[order]
+    pos = label[order] * wt[order]
+    neg = (1.0 - label[order]) * wt[order]
+    # tie-group id per element (first element group 0)
+    new_grp = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                               (s[1:] != s[:-1]).astype(jnp.int32)])
+    gid = jnp.cumsum(new_grp)
+    grp_pos = jax.ops.segment_sum(pos, gid, num_segments=n)
+    grp_neg = jax.ops.segment_sum(neg, gid, num_segments=n)
+    pos_before = jnp.cumsum(grp_pos) - grp_pos
+    accum = jnp.sum(grp_neg * (0.5 * grp_pos + pos_before))
+    sum_pos = jnp.sum(grp_pos)
+    sum_weights = params["sum_weights"]
+    auc = jnp.where((sum_pos > 0.0) & (sum_pos != sum_weights),
+                    accum / (sum_pos * (sum_weights - sum_pos)), 1.0)
+    return auc[None]
+
+
+# -------------------------------------------------------------------- NDCG
+
+def _ndcg_metric(params, score, *, ks, block):
+    """NDCG@ks over padded queries (rank_metric.hpp:16-167): queries are
+    gathered into a [nq, qmax] layout (like the lambdarank objective),
+    sorted per query, and DCG@k read off the sorted gains; all-negative
+    queries (inv_max <= 0) count as 1.0 regardless of query weight."""
+    score = score.astype(jnp.float32)
+    doc_index = params["doc_index"]            # [nq, qmax]
+    valid = params["valid"]
+    labels = params["labels"]                  # [nq, qmax] int32
+    inv_max = params["inv_max"]                # [nq, n_ks]
+    gains_tbl = params["gains"]                # [max_label+1]
+    discount = params["discount"]              # [qmax]
+    qw = params["query_weights"]               # [nq] or None
+    nq, qmax = doc_index.shape
+
+    s_pad = jnp.where(valid, score[doc_index], -jnp.inf)
+
+    def one_query(s, l):
+        order = jnp.argsort(-s, stable=True)   # padded (-inf) sink last
+        lg = gains_tbl[l[order]]
+        contrib = lg * discount
+        # dcg@k = sum of contrib over ranks < k (invalid ranks contribute 0
+        # because their labels gather gain of label 0... mask explicitly)
+        ok = jnp.isfinite(s[order])
+        contrib = jnp.where(ok, contrib, 0.0)
+        cum = jnp.cumsum(contrib)
+        return jnp.stack([cum[min(k, qmax) - 1] for k in ks])
+
+    pad_q = (-nq) % block
+    def pad0(x):
+        return jnp.pad(x, [(0, pad_q)] + [(0, 0)] * (x.ndim - 1))
+    blocks = (nq + pad_q) // block
+
+    def block_fn(args):
+        s_b, l_b = args
+        return jax.vmap(one_query)(s_b, l_b)
+
+    dcgs = jax.lax.map(
+        block_fn,
+        (pad0(s_pad).reshape(blocks, block, qmax),
+         pad0(labels).reshape(blocks, block, qmax))).reshape(-1, len(ks))[:nq]
+
+    wq = jnp.ones((nq,), jnp.float32) if qw is None else qw
+    all_neg = inv_max[:, 0] <= 0.0
+    per_q = jnp.where(all_neg[:, None], 1.0, dcgs * inv_max * wq[:, None])
+    return jnp.sum(per_q, axis=0) / params["sum_query_weights"]
+
+
+# one callable per static key so program caches can use function identity
+_NDCG_FNS: dict = {}
+
+
+def ndcg_fn(ks: tuple, block: int):
+    key = (ks, block)
+    fn = _NDCG_FNS.get(key)
+    if fn is None:
+        fn = functools.partial(_ndcg_metric, ks=ks, block=block)
+        _NDCG_FNS[key] = fn
+    return fn

@@ -267,8 +267,7 @@ class GBDT:
         """Drive the full training loop (Application::Train,
         application.cpp:239-257), fusing iterations into device chunks when
         no per-iteration metric output is needed."""
-        if (is_eval or not self.supports_chunking
-                or num_iterations < chunk_size):
+        if not self.supports_chunking or num_iterations < chunk_size:
             # short runs use the per-iteration path: its grower program is
             # module-jitted (shared across boosters), while a chunk shorter
             # than chunk_size would waste the surplus iterations it computes
@@ -287,7 +286,8 @@ class GBDT:
                 # chunk would re-trace the scan and pay a second multi-
                 # minute compile); surplus iterations are rolled back
                 stop = self.train_chunk(chunk_size,
-                                        limit=num_iterations - done)
+                                        limit=num_iterations - done,
+                                        is_eval=is_eval)
                 if save_fn is not None:
                     save_fn()
                 if progress_fn is not None:
@@ -301,14 +301,34 @@ class GBDT:
     @property
     def supports_chunking(self) -> bool:
         """True when fused multi-iteration training applies: serial learner
-        (the parallel learners own their shard_map programs) and no
-        per-iteration metric consumers (valid sets imply eval/early-stop,
-        which need host metric values every iteration)."""
-        return (self._learner is _serial_learner and not self.valid_datasets
-                and self.early_stopping_round <= 0
-                and hasattr(self.objective, "chunk_spec"))
+        (the parallel learners own their shard_map programs), a
+        chunk-traceable objective, and device formulations for every
+        configured metric (metrics/device.py) — metric values and valid
+        scores are then computed INSIDE the chunk program and early
+        stopping is applied post-hoc with identical semantics."""
+        if (self._learner is not _serial_learner
+                or not hasattr(self.objective, "chunk_spec")):
+            return False
+        from ..metrics import Metric as _MetricBase
+        for ms in [self.training_metrics] + self.valid_metrics:
+            for m in ms:
+                if type(m).device_spec is _MetricBase.device_spec:
+                    return False
+        return True
 
-    def train_chunk(self, k: int, limit: int = -1) -> bool:
+    def _metric_spec(self, metric):
+        """Cached device_spec per metric instance (NDCG builds large padded
+        tables; no reason to rebuild them per chunk)."""
+        cache = getattr(self, "_metric_spec_cache", None)
+        if cache is None:
+            cache = self._metric_spec_cache = {}
+        spec = cache.get(id(metric))
+        if spec is None:
+            spec = cache[id(metric)] = metric.device_spec()
+        return spec
+
+    def train_chunk(self, k: int, limit: int = -1,
+                    is_eval: bool = False) -> bool:
         """Run ``k`` boosting iterations as ONE device program.
 
         The reference pays a host round-trip per split; the per-iteration
@@ -319,24 +339,34 @@ class GBDT:
         host is touched ONCE per chunk: upload of the per-iteration
         bagging/feature masks, readback of the k stacked tree arrays.
 
-        Semantics match k calls of train_one_iter(is_eval=False) exactly
-        (same RNG draws for bagging/feature sampling, same degenerate-tree
-        stop: training truncates at the first tree with <= 1 leaf).
-        Returns True when training must stop.
+        Semantics match k calls of train_one_iter exactly (same RNG draws
+        for bagging/feature sampling, same degenerate-tree stop, same
+        per-iteration metric/early-stopping bookkeeping — metric values and
+        valid-set scores are computed inside the program and consumed on the
+        host post-hoc).  Returns True when training must stop.
 
         ``limit`` < k keeps only the first ``limit`` iterations and rolls
-        the RNG streams and score back to that point — used by run_training
+        the RNG streams and scores back to that point — used by run_training
         to serve a short tail with the full-size compiled program instead of
-        re-compiling a second program for the remainder.
+        re-compiling a second program for the remainder.  An early stop at
+        iteration i similarly rolls back to i+1 kept iterations before the
+        reference's model pop-back.
         """
         if not self.supports_chunking:
             raise RuntimeError(
-                "train_chunk requires the serial learner, no valid "
-                "datasets and no early stopping (see supports_chunking); "
-                "use train_one_iter / run_training instead")
+                "train_chunk requires the serial learner, a chunk-traceable "
+                "objective and device-capable metrics (see "
+                "supports_chunking); use train_one_iter / run_training")
         has_bag = self._use_bagging
         has_ff = self.tree_config.feature_fraction < 1.0
         obj_key, obj_params, grad_fn = self.objective.chunk_spec()
+        eval_each = bool(is_eval
+                         and (self.training_metrics or self.valid_datasets))
+        train_specs = ([self._metric_spec(m) for m in self.training_metrics]
+                       if eval_each else [])
+        valid_specs = ([[self._metric_spec(m) for m in ms]
+                        for ms in self.valid_metrics] if eval_each else
+                       [[] for _ in self.valid_metrics])
         fn = _get_chunk_program(
             obj_key, grad_fn, self.num_class,
             float(self.gbdt_config.learning_rate),
@@ -346,16 +376,20 @@ class GBDT:
             min_data_in_leaf=self.tree_config.min_data_in_leaf,
             min_sum_hessian_in_leaf=self.tree_config.min_sum_hessian_in_leaf,
             max_depth=self.tree_config.max_depth,
-            has_bag=has_bag, has_ff=has_ff)
+            has_bag=has_bag, has_ff=has_ff,
+            train_metric_fns=tuple(s[2] for s in train_specs),
+            valid_metric_fns=tuple(tuple(s[2] for s in specs)
+                                   for specs in valid_specs))
 
         C, N, F = self.num_class, self.num_data, self.num_features
-        # snapshots for the (rare) degenerate-tree stop: training must then
-        # look exactly like it stopped at that iteration — RNG streams and
-        # score included
+        # snapshots for early/degenerate stops and tail truncation: training
+        # must then look exactly like it stopped at that iteration — RNG
+        # streams and train/valid scores included
         bag_state = self._bag_rng.get_state() if has_bag else None
         ff_states = ([r.get_state() for r in self._feat_rngs]
                      if has_ff else None)
         score_before = self.score
+        valid_before = [e["score"] for e in self.valid_datasets]
 
         if has_bag:
             rms = np.empty((k, C, N), dtype=bool)
@@ -375,12 +409,18 @@ class GBDT:
         else:
             feat_masks = jnp.zeros((k, 1), jnp.bool_)
 
-        self.score, stacked = fn(self.score, self.bins_device,
-                                 self.num_bins_device, row_masks, feat_masks,
-                                 obj_params)
+        self.score, vscores_out, stacked, mvals = fn(
+            self.score, self.bins_device, self.num_bins_device,
+            row_masks, feat_masks, obj_params,
+            tuple(s[1] for s in train_specs),
+            tuple(e["bins"] for e in self.valid_datasets),
+            tuple(e["score"] for e in self.valid_datasets),
+            tuple(tuple(s[1] for s in specs) for specs in valid_specs))
         host = jax.device_get(stacked)
+        mvals_host = np.asarray(mvals) if eval_each else None
 
         keep_iters = k if limit < 0 else min(k, limit)
+        esr = self.early_stopping_round
         for i in range(keep_iters):
             for cls in range(C):
                 sub = jax.tree.map(lambda a: a[i, cls], host)
@@ -390,27 +430,73 @@ class GBDT:
                     # the degenerate pair consumed its RNG draws but
                     # produced no tree
                     self._rollback_chunk(i * C + cls + 1, i * C + cls,
-                                         bag_state, ff_states, score_before)
+                                         bag_state, ff_states, score_before,
+                                         valid_before)
                     self.iter += i
                     return True
                 tree = self._to_host_tree(sub)
                 tree.shrinkage(self.gbdt_config.learning_rate)
                 self.models.append(tree)
+            if eval_each:
+                train_vals, valid_vals = self._split_metric_values(
+                    mvals_host[i])
+                if self._consume_metric_values(self.iter + i + 1,
+                                               train_vals, valid_vals):
+                    kept = i + 1
+                    log.info("Early stopping at iteration %d, the best "
+                             "iteration round is %d"
+                             % (self.iter + kept, self.iter + kept - esr))
+                    # first restore state to exactly `kept` iterations
+                    # (reference semantics: scores keep the popped trees'
+                    # contributions, so roll back only the surplus scan
+                    # iterations), THEN pop the early-stopping window
+                    if kept < k:
+                        self._rollback_chunk(kept * C, kept * C, bag_state,
+                                             ff_states, score_before,
+                                             valid_before)
+                    else:
+                        for e, s in zip(self.valid_datasets, vscores_out):
+                            e["score"] = s
+                    del self.models[len(self.models) - esr * C:]
+                    self.iter += kept
+                    return True
         if keep_iters < k:
             self._rollback_chunk(keep_iters * C, keep_iters * C,
-                                 bag_state, ff_states, score_before)
+                                 bag_state, ff_states, score_before,
+                                 valid_before)
+        else:
+            for e, s in zip(self.valid_datasets, vscores_out):
+                e["score"] = s
         self.iter += keep_iters
         return False
 
+    def _split_metric_values(self, vals: np.ndarray):
+        """Unpack one iteration's concatenated device metric vector into
+        (train_vals, valid_vals) lists shaped like the host eval path."""
+        off = 0
+
+        def take(metric):
+            nonlocal off
+            n = metric.n_values()
+            out = [float(v) for v in vals[off:off + n]]
+            off += n
+            return out
+
+        train_vals = [take(m) for m in self.training_metrics]
+        valid_vals = [[take(m) for m in ms] for ms in self.valid_metrics]
+        return train_vals, valid_vals
+
     def _rollback_chunk(self, replay_pairs: int, kept_trees: int,
-                        bag_state, ff_states, score_before) -> None:
+                        bag_state, ff_states, score_before,
+                        valid_before=()) -> None:
         """Restore exact per-iteration semantics after a chunk that kept
-        fewer iterations than it ran (mid-chunk degenerate-tree stop, or a
-        run_training tail served by the full-size program): rewind the
-        bagging/feature RNG streams and replay exactly ``replay_pairs``
-        (iteration, class) draws, and rebuild the score from the pre-chunk
-        score plus this chunk's ``kept_trees`` trees (the scan had already
-        applied the discarded iterations' updates on device)."""
+        fewer iterations than it ran (mid-chunk degenerate-tree stop, early
+        stop, or a run_training tail served by the full-size program):
+        rewind the bagging/feature RNG streams and replay exactly
+        ``replay_pairs`` (iteration, class) draws, and rebuild the train and
+        valid scores from the pre-chunk scores plus this chunk's
+        ``kept_trees`` trees (the scan had already applied the discarded
+        iterations' updates on device)."""
         C = self.num_class
         if bag_state is not None:
             self._bag_rng.set_state(bag_state)
@@ -424,17 +510,14 @@ class GBDT:
 
         kept = self.models[len(self.models) - kept_trees:] \
             if kept_trees > 0 else []
-        score = score_before
         max_nodes = max(_effective_num_leaves(self.tree_config) - 1, 1)
-        for m, tree in enumerate(kept):
-            cls_m = m % C
-            pad = lambda a, fill=0: np.pad(
-                np.asarray(a), (0, max_nodes - len(a)),
-                constant_values=fill)
+
+        def replay(score, bins, tree, cls_m):
+            pad = lambda a: np.pad(np.asarray(a), (0, max_nodes - len(a)))
             leaf_vals = np.zeros(max_nodes + 1, np.float32)
             leaf_vals[:tree.num_leaves] = tree.leaf_value
-            score = score.at[cls_m].set(add_tree_score(
-                self.bins_device, score[cls_m],
+            return score.at[cls_m].set(add_tree_score(
+                bins, score[cls_m],
                 jnp.asarray(pad(tree.split_feature)),
                 jnp.asarray(pad(tree.threshold_bin)),
                 jnp.asarray(pad(tree.left_child)),
@@ -442,7 +525,17 @@ class GBDT:
                 jnp.asarray(leaf_vals),
                 jnp.asarray(tree.num_leaves),
                 max_nodes=max_nodes))
+
+        score = score_before
+        vscores = list(valid_before)
+        for m, tree in enumerate(kept):
+            cls_m = m % C
+            score = replay(score, self.bins_device, tree, cls_m)
+            for v, entry in enumerate(self.valid_datasets):
+                vscores[v] = replay(vscores[v], entry["bins"], tree, cls_m)
         self.score = score
+        for entry, s in zip(self.valid_datasets, vscores):
+            entry["score"] = s
 
     def _to_host_tree(self, host) -> Tree:
         """Build the host Tree from an already-device_get'd TreeArrays."""
@@ -470,39 +563,63 @@ class GBDT:
     # --------------------------------------------------------------- metrics
 
     def output_metric(self, iteration: int) -> bool:
-        """GBDT::OutputMetric (gbdt.cpp:225-259)."""
-        ret = False
+        """GBDT::OutputMetric (gbdt.cpp:225-259), host-eval path."""
         freq = self.gbdt_config.output_freq
-        if freq > 0 and iteration % freq == 0:
+        eval_now = freq > 0 and iteration % freq == 0
+        train_vals = None
+        if eval_now and self.training_metrics:
             score_np = np.asarray(self.score)
-            for metric in self.training_metrics:
-                values = metric.eval(score_np.reshape(-1)
-                                     if self.num_class > 1 else score_np[0])
+            flat = (score_np.reshape(-1) if self.num_class > 1
+                    else score_np[0])
+            train_vals = [m.eval(flat) for m in self.training_metrics]
+        valid_vals = None
+        if self.valid_datasets and (eval_now
+                                    or self.early_stopping_round > 0):
+            valid_vals = []
+            for i, entry in enumerate(self.valid_datasets):
+                score_np = np.asarray(entry["score"])
+                flat = (score_np.reshape(-1) if self.num_class > 1
+                        else score_np[0])
+                valid_vals.append([m.eval(flat)
+                                   for m in self.valid_metrics[i]])
+        return self._consume_metric_values(iteration, train_vals, valid_vals)
+
+    def _consume_metric_values(self, iteration: int, train_vals,
+                               valid_vals) -> bool:
+        """Shared logging + early-stopping bookkeeping over metric VALUES
+        (computed on host by output_metric, or on device by train_chunk).
+        Mirrors gbdt.cpp:225-259: train metrics print on output_freq
+        boundaries; valid metrics additionally drive the best-score /
+        early-stop state every iteration."""
+        freq = self.gbdt_config.output_freq
+        eval_now = freq > 0 and iteration % freq == 0
+        ret = False
+        if eval_now and train_vals is not None:
+            for metric, values in zip(self.training_metrics, train_vals):
                 log.info("Iteration:%d, %s : %s"
                          % (iteration, metric.name,
                             " ".join(str(v) for v in values)))
-        for i, entry in enumerate(self.valid_datasets):
-            eval_now = (freq > 0 and iteration % freq == 0)
-            if not eval_now and self.early_stopping_round <= 0:
-                continue
-            score_np = np.asarray(entry["score"])
-            for j, metric in enumerate(self.valid_metrics[i]):
-                values = metric.eval(score_np.reshape(-1)
-                                     if self.num_class > 1 else score_np[0])
-                if eval_now:
-                    log.info("Iteration:%d, %s : %s"
-                             % (iteration, metric.name,
-                                " ".join(str(v) for v in values)))
-                if not ret and self.early_stopping_round > 0:
-                    bigger_better = metric.is_bigger_better
-                    last = values[-1]
-                    if (self.best_score[i][j] < 0
-                            or (not bigger_better and last < self.best_score[i][j])
-                            or (bigger_better and last > self.best_score[i][j])):
-                        self.best_score[i][j] = last
-                        self.best_iter[i][j] = iteration
-                    elif iteration - self.best_iter[i][j] >= self.early_stopping_round:
-                        ret = True
+        if valid_vals is not None:
+            for i in range(len(self.valid_datasets)):
+                for j, metric in enumerate(self.valid_metrics[i]):
+                    values = valid_vals[i][j]
+                    if eval_now:
+                        log.info("Iteration:%d, %s : %s"
+                                 % (iteration, metric.name,
+                                    " ".join(str(v) for v in values)))
+                    if not ret and self.early_stopping_round > 0:
+                        bigger_better = metric.is_bigger_better
+                        last = values[-1]
+                        if (self.best_score[i][j] < 0
+                                or (not bigger_better
+                                    and last < self.best_score[i][j])
+                                or (bigger_better
+                                    and last > self.best_score[i][j])):
+                            self.best_score[i][j] = last
+                            self.best_iter[i][j] = iteration
+                        elif (iteration - self.best_iter[i][j]
+                                >= self.early_stopping_round):
+                            ret = True
         return ret
 
     # ------------------------------------------------------------ prediction
@@ -659,10 +776,14 @@ def _get_chunk_program(obj_key, grad_fn, num_class: int, lr: float,
                        grow_policy: str, *, num_leaves: int,
                        num_bins_max: int, min_data_in_leaf: int,
                        min_sum_hessian_in_leaf: float, max_depth: int,
-                       has_bag: bool, has_ff: bool):
+                       has_bag: bool, has_ff: bool,
+                       train_metric_fns: tuple = (),
+                       valid_metric_fns: tuple = ()):
     key = (obj_key, id(grad_fn), num_class, lr, grow_policy, num_leaves,
            num_bins_max, min_data_in_leaf, min_sum_hessian_in_leaf,
-           max_depth, has_bag, has_ff)
+           max_depth, has_bag, has_ff,
+           tuple(id(f) for f in train_metric_fns),
+           tuple(tuple(id(f) for f in fns) for fns in valid_metric_fns))
     prog = _CHUNK_PROGRAMS.get(key)
     if prog is not None:
         return prog
@@ -676,17 +797,22 @@ def _get_chunk_program(obj_key, grad_fn, num_class: int, lr: float,
     else:
         from .grower import grow_tree_impl as grow
     lrf = jnp.float32(lr)
+    n_valid = len(valid_metric_fns)
+    max_nodes = max(num_leaves - 1, 1)
 
-    def chunk_fn(score, bins, num_bins, row_masks, feat_masks, obj_params):
+    def chunk_fn(score, bins, num_bins, row_masks, feat_masks, obj_params,
+                 train_mparams, valid_bins, valid_scores, valid_mparams):
         F, N = bins.shape
 
-        def body(score, xs):
+        def body(carry, xs):
+            score, vscores = carry
             rmask, fmask = xs
             grad, hess = grad_fn(obj_params,
                                  score if num_class > 1 else score[0])
             if num_class == 1:
                 grad, hess = grad[None], hess[None]
             outs = []
+            vscores = list(vscores)
             for cls in range(num_class):
                 rm = rmask[cls] if has_bag else jnp.ones((N,), jnp.bool_)
                 fm = fmask[cls] if has_ff else jnp.ones((F,), jnp.bool_)
@@ -695,11 +821,31 @@ def _get_chunk_program(obj_key, grad_fn, num_class: int, lr: float,
                 shrunk = jnp.where(ta.num_leaves > 1,
                                    ta.leaf_value * lrf, 0.0)
                 score = score.at[cls].add(shrunk[ta.leaf_ids])
+                # valid scores by tree replay (gbdt.cpp:220-222)
+                for v in range(n_valid):
+                    vscores[v] = vscores[v].at[cls].set(add_tree_score(
+                        valid_bins[v], vscores[v][cls], ta.split_feature,
+                        ta.threshold_bin, ta.left_child, ta.right_child,
+                        shrunk, ta.num_leaves, max_nodes=max_nodes))
                 outs.append(ta._replace(leaf_ids=jnp.zeros((0,), jnp.int32)))
             stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *outs)
-            return score, stacked
 
-        return jax.lax.scan(body, score, (row_masks, feat_masks))
+            # in-program metric evaluation (Metric::Eval on CPU threads in
+            # the reference; here the scores never leave the device)
+            mv = []
+            for f, p in zip(train_metric_fns, train_mparams):
+                mv.append(f(p, score if num_class > 1 else score[0]))
+            for v in range(n_valid):
+                sv = vscores[v] if num_class > 1 else vscores[v][0]
+                for f, p in zip(valid_metric_fns[v], valid_mparams[v]):
+                    mv.append(f(p, sv))
+            mvals = (jnp.concatenate(mv) if mv
+                     else jnp.zeros((0,), jnp.float32))
+            return (score, tuple(vscores)), (stacked, mvals)
+
+        (score, vscores), (stacked, mvals) = jax.lax.scan(
+            body, (score, tuple(valid_scores)), (row_masks, feat_masks))
+        return score, vscores, stacked, mvals
 
     prog = jax.jit(chunk_fn)
     _CHUNK_PROGRAMS[key] = prog

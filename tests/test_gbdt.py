@@ -337,3 +337,87 @@ def test_run_training_tail_truncation(synthetic_binary):
                                   b2._bag_rng.randint(0, 1 << 30, 5))
     np.testing.assert_array_equal(b1._feat_rngs[0].randint(0, 1 << 30, 5),
                                   b2._feat_rngs[0].randint(0, 1 << 30, 5))
+
+
+def _make_booster(ds, params, valid=None):
+    from lightgbm_tpu.config import OverallConfig
+    from lightgbm_tpu.metrics import create_metric
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.objectives import create_objective
+
+    cfg = OverallConfig()
+    cfg.set({k: str(v) for k, v in params.items()}, require_data=False)
+    b = GBDT()
+    obj = create_objective(cfg.objective_type, cfg.objective_config)
+    train_metrics = [m for m in (create_metric(t, cfg.metric_config)
+                                 for t in cfg.metric_types) if m is not None]
+    b.init(cfg.boosting_config, ds, obj, train_metrics)
+    if valid is not None:
+        metrics = [m for m in (create_metric(t, cfg.metric_config)
+                               for t in cfg.metric_types) if m is not None]
+        b.add_valid_dataset(valid, metrics)
+    return b
+
+
+def test_chunked_eval_matches_per_iter(synthetic_binary):
+    """Chunked training WITH metrics/valid sets: same models, same valid
+    scores, same early-stop bookkeeping as the per-iteration path."""
+    x, y = synthetic_binary
+    xt, yt = x[:1500], y[:1500]
+    xv, yv = x[1500:], y[1500:]
+    params = dict(BASE, num_iterations=6)
+    ds = Dataset.from_arrays(xt, yt, max_bin=64)
+    dsv = Dataset.from_arrays(xv, yv, max_bin=64, reference=ds)
+
+    b1 = _make_booster(ds, params, valid=dsv)
+    for _ in range(6):
+        if b1.train_one_iter(is_eval=True):
+            break
+
+    b2 = _make_booster(ds, params, valid=dsv)
+    assert b2.supports_chunking
+    b2.run_training(6, is_eval=True, chunk_size=3)
+
+    assert len(b1.models) == len(b2.models)
+    for t1, t2 in zip(b1.models, b2.models):
+        np.testing.assert_array_equal(t1.split_feature, t2.split_feature)
+    np.testing.assert_allclose(
+        np.asarray(b1.valid_datasets[0]["score"]),
+        np.asarray(b2.valid_datasets[0]["score"]), rtol=1e-3, atol=1e-4)
+    # early-stop bookkeeping tracked identically (within device-f32 noise)
+    np.testing.assert_allclose(b1.best_score[0], b2.best_score[0], rtol=1e-4)
+
+
+def test_chunked_early_stopping_matches_per_iter(synthetic_binary):
+    """Early stopping fires at the same iteration with the same model
+    pop-back whether evaluation runs per-iteration on host or in-chunk on
+    device."""
+    x, y = synthetic_binary
+    # tiny noisy valid set -> early overfitting -> stop triggers
+    xt, yt = x[:1800], y[:1800]
+    rng = np.random.RandomState(0)
+    xv = x[1800:]
+    yv = rng.randint(0, 2, size=len(xv)).astype(np.float32)  # pure noise
+    params = dict(BASE, num_iterations=40, learning_rate=0.4,
+                  early_stopping_round=3, metric="binary_logloss")
+    ds = Dataset.from_arrays(xt, yt, max_bin=64)
+    dsv = Dataset.from_arrays(xv, yv, max_bin=64, reference=ds)
+
+    b1 = _make_booster(ds, params, valid=dsv)
+    stopped1 = False
+    for _ in range(40):
+        if b1.train_one_iter(is_eval=True):
+            stopped1 = True
+            break
+
+    b2 = _make_booster(ds, params, valid=dsv)
+    assert b2.supports_chunking
+    b2.run_training(40, is_eval=True, chunk_size=5)
+
+    if not stopped1:
+        pytest.skip("fixture did not early-stop")
+    assert b1.iter == b2.iter
+    assert len(b1.models) == len(b2.models)
+    for t1, t2 in zip(b1.models, b2.models):
+        np.testing.assert_array_equal(t1.split_feature, t2.split_feature)
+    np.testing.assert_array_equal(b1.best_iter[0], b2.best_iter[0])
