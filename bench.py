@@ -39,7 +39,7 @@ def main() -> int:
     parser.add_argument("--features", type=int, default=28)
     parser.add_argument("--leaves", type=int, default=255)
     parser.add_argument("--max-bin", type=int, default=255)
-    parser.add_argument("--iters", type=int, default=5,
+    parser.add_argument("--iters", type=int, default=16,
                         help="iterations per chunk; one chunk warms up "
                              "(compiles) and one chunk is timed")
     parser.add_argument("--grow-policy", default="depthwise",

@@ -360,8 +360,12 @@ class GBDT:
         has_bag = self._use_bagging
         has_ff = self.tree_config.feature_fraction < 1.0
         obj_key, obj_params, grad_fn = self.objective.chunk_spec()
+        # no consumer -> no in-program evaluation: with output_freq == 0 and
+        # no early stopping the per-iteration path evaluates nothing either
         eval_each = bool(is_eval
-                         and (self.training_metrics or self.valid_datasets))
+                         and (self.training_metrics or self.valid_datasets)
+                         and (self.gbdt_config.output_freq > 0
+                              or self.early_stopping_round > 0))
         train_specs = ([self._metric_spec(m) for m in self.training_metrics]
                        if eval_each else [])
         valid_specs = ([[self._metric_spec(m) for m in ms]

@@ -255,7 +255,11 @@ def grow_tree_depthwise(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         # index lists (data_partition.hpp); this is the masked-dense
         # equivalent.
         sel = small_sel & row_mask
-        if compact_rows:
+        # compaction pays for itself only when the batched matmul is wide:
+        # at C <= 42 (vals operand one 128-lane tile) a full-N pass costs
+        # about the same as the cumsum+scatter+gather of compaction plus a
+        # half-N pass, so skip the index plumbing for shallow levels
+        if compact_rows and P > 42:
             # The N/2 capacity proof needs smaller-child identity and the
             # compacted row population to use the SAME counts; under the
             # data-parallel learner 'smaller' comes from GLOBAL (psum'd)
