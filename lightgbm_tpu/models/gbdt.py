@@ -267,7 +267,7 @@ class GBDT:
         """Drive the full training loop (Application::Train,
         application.cpp:239-257), fusing iterations into device chunks when
         no per-iteration metric output is needed."""
-        if not self.supports_chunking or num_iterations < chunk_size:
+        if not self.chunkable_for(is_eval) or num_iterations < chunk_size:
             # short runs use the per-iteration path: its grower program is
             # module-jitted (shared across boosters), while a chunk shorter
             # than chunk_size would waste the surplus iterations it computes
@@ -316,6 +316,26 @@ class GBDT:
                     return False
         return True
 
+    def chunkable_for(self, is_eval: bool) -> bool:
+        """Chunking decision for run_training.  The serial learner chunks
+        with full eval support (supports_chunking); the data-parallel
+        learner chunks only eval-free runs with row-shardable objective
+        state (metric evaluation under shard_map — AUC's global sort — is
+        not implemented)."""
+        if self.supports_chunking:
+            return True
+        from ..parallel.learners import DataParallelLearner
+        if (isinstance(self._learner, DataParallelLearner)
+                and hasattr(self.objective, "chunk_spec")
+                and getattr(self.objective, "rows_aligned_params", False)
+                and not self.valid_datasets):
+            needs_eval = bool(
+                is_eval and self.training_metrics
+                and (self.gbdt_config.output_freq > 0
+                     or self.early_stopping_round > 0))
+            return not needs_eval
+        return False
+
     def _metric_spec(self, metric):
         """Cached device_spec per metric instance (NDCG builds large padded
         tables; no reason to rebuild them per chunk)."""
@@ -352,38 +372,52 @@ class GBDT:
         iteration i similarly rolls back to i+1 kept iterations before the
         reference's model pop-back.
         """
-        if not self.supports_chunking:
+        if not self.chunkable_for(is_eval):
             raise RuntimeError(
-                "train_chunk requires the serial learner, a chunk-traceable "
-                "objective and device-capable metrics (see "
-                "supports_chunking); use train_one_iter / run_training")
+                "train_chunk requires a chunk-traceable objective and either "
+                "the serial learner (with device-capable metrics) or the "
+                "data-parallel learner without eval consumers (see "
+                "chunkable_for); use train_one_iter / run_training")
         has_bag = self._use_bagging
         has_ff = self.tree_config.feature_fraction < 1.0
         obj_key, obj_params, grad_fn = self.objective.chunk_spec()
-        # no consumer -> no in-program evaluation: with output_freq == 0 and
-        # no early stopping the per-iteration path evaluates nothing either
-        eval_each = bool(is_eval
-                         and (self.training_metrics or self.valid_datasets)
-                         and (self.gbdt_config.output_freq > 0
-                              or self.early_stopping_round > 0))
-        train_specs = ([self._metric_spec(m) for m in self.training_metrics]
-                       if eval_each else [])
-        valid_specs = ([[self._metric_spec(m) for m in ms]
-                        for ms in self.valid_metrics] if eval_each else
-                       [[] for _ in self.valid_metrics])
-        fn = _get_chunk_program(
-            obj_key, grad_fn, self.num_class,
-            float(self.gbdt_config.learning_rate),
-            getattr(self.tree_config, "grow_policy", "leafwise"),
-            num_leaves=_effective_num_leaves(self.tree_config),
-            num_bins_max=self.num_bins_max,
-            min_data_in_leaf=self.tree_config.min_data_in_leaf,
-            min_sum_hessian_in_leaf=self.tree_config.min_sum_hessian_in_leaf,
-            max_depth=self.tree_config.max_depth,
-            has_bag=has_bag, has_ff=has_ff,
-            train_metric_fns=tuple(s[2] for s in train_specs),
-            valid_metric_fns=tuple(tuple(s[2] for s in specs)
-                                   for specs in valid_specs))
+        dp = self._learner is not _serial_learner
+        pad = 0
+        if dp:
+            eval_each = False
+            train_specs = []
+            valid_specs = [[] for _ in self.valid_metrics]
+            fn, num_shards = self._learner.chunk_program(
+                self, obj_key, grad_fn, obj_params, has_bag, has_ff)
+            pad = (-self.num_data) % num_shards
+        else:
+            # no consumer -> no in-program evaluation: with output_freq == 0
+            # and no early stopping the per-iteration path evaluates nothing
+            # either
+            eval_each = bool(
+                is_eval and (self.training_metrics or self.valid_datasets)
+                and (self.gbdt_config.output_freq > 0
+                     or self.early_stopping_round > 0))
+            train_specs = ([self._metric_spec(m)
+                            for m in self.training_metrics]
+                           if eval_each else [])
+            valid_specs = ([[self._metric_spec(m) for m in ms]
+                            for ms in self.valid_metrics] if eval_each else
+                           [[] for _ in self.valid_metrics])
+            fn = _get_chunk_program(
+                obj_key, grad_fn, self.num_class,
+                float(self.gbdt_config.learning_rate),
+                getattr(self.tree_config, "grow_policy", "leafwise"),
+                num_leaves=_effective_num_leaves(self.tree_config),
+                num_bins_max=self.num_bins_max,
+                min_data_in_leaf=self.tree_config.min_data_in_leaf,
+                min_sum_hessian_in_leaf=(
+                    self.tree_config.min_sum_hessian_in_leaf),
+                max_depth=self.tree_config.max_depth,
+                has_bag=has_bag, has_ff=has_ff,
+                train_metric_fns=tuple(s[2] for s in train_specs),
+                valid_metric_fns=tuple(tuple(s[2] for s in specs)
+                                       for specs in valid_specs))
 
         C, N, F = self.num_class, self.num_data, self.num_features
         # snapshots for early/degenerate stops and tail truncation: training
@@ -396,11 +430,11 @@ class GBDT:
         valid_before = [e["score"] for e in self.valid_datasets]
 
         if has_bag:
-            rms = np.empty((k, C, N), dtype=bool)
+            rms = np.zeros((k, C, N + pad), dtype=bool)
             for i in range(k):
                 for cls in range(C):
                     self._draw_bag_mask(self.iter + i)
-                    rms[i, cls] = self._bag_mask
+                    rms[i, cls, :N] = self._bag_mask
             row_masks = jnp.asarray(rms)
         else:
             row_masks = jnp.zeros((k, 1), jnp.bool_)   # scan driver only
@@ -413,13 +447,37 @@ class GBDT:
         else:
             feat_masks = jnp.zeros((k, 1), jnp.bool_)
 
-        self.score, vscores_out, stacked, mvals = fn(
-            self.score, self.bins_device, self.num_bins_device,
-            row_masks, feat_masks, obj_params,
-            tuple(s[1] for s in train_specs),
-            tuple(e["bins"] for e in self.valid_datasets),
-            tuple(e["score"] for e in self.valid_datasets),
-            tuple(tuple(s[1] for s in specs) for specs in valid_specs))
+        if dp:
+            # pad rows to the shard grid once per booster; padded rows are
+            # masked out of histograms/stats by valid_rows and their score
+            # lane is sliced off again below
+            cache = getattr(self, "_dp_chunk_inputs", None)
+            if cache is None or cache[0] != num_shards:
+                bins_p = (jnp.pad(self.bins_device, ((0, 0), (0, pad)))
+                          if pad else self.bins_device)
+                obj_p = jax.tree.map(
+                    lambda l: (jnp.pad(l, [(0, pad)] + [(0, 0)]
+                                       * (l.ndim - 1))
+                               if pad and getattr(l, "ndim", 0) >= 1 else l),
+                    obj_params)
+                valid_rows = jnp.arange(N + pad) < N
+                cache = (num_shards, bins_p, obj_p, valid_rows)
+                self._dp_chunk_inputs = cache
+            _, bins_p, obj_p, valid_rows = cache
+            score_in = (jnp.pad(self.score, ((0, 0), (0, pad)))
+                        if pad else self.score)
+            new_score, stacked = fn(score_in, bins_p, self.num_bins_device,
+                                    valid_rows, row_masks, feat_masks, obj_p)
+            self.score = new_score[:, :N] if pad else new_score
+            vscores_out, mvals = (), None
+        else:
+            self.score, vscores_out, stacked, mvals = fn(
+                self.score, self.bins_device, self.num_bins_device,
+                row_masks, feat_masks, obj_params,
+                tuple(s[1] for s in train_specs),
+                tuple(e["bins"] for e in self.valid_datasets),
+                tuple(e["score"] for e in self.valid_datasets),
+                tuple(tuple(s[1] for s in specs) for specs in valid_specs))
         host = jax.device_get(stacked)
         mvals_host = np.asarray(mvals) if eval_each else None
 
@@ -776,6 +834,63 @@ class GBDT:
 _CHUNK_PROGRAMS: dict = {}
 
 
+def make_chunk_body(*, grad_fn, obj_params, num_class: int, lrf, grow_fn,
+                    has_bag: bool, has_ff: bool, bins, num_bins,
+                    base_mask=None, max_nodes: int = 1,
+                    valid_bins=(), valid_mparams=(),
+                    train_metric_fns=(), train_mparams=(),
+                    valid_metric_fns=()):
+    """The per-iteration boosting body shared by the serial chunk program
+    and the data-parallel shard_map chunk (parallel/learners.py):
+    gradients → per-class grow → train-score update (+ valid-score replay
+    and in-program metric evaluation when configured).  ``grow_fn`` carries
+    the grower statics — and, for the data-parallel case, the psum
+    hist/stat reducers; ``base_mask`` is the always-on row validity mask
+    (shard padding) and composes with the per-iteration bagging mask."""
+    F, N = bins.shape
+    n_valid = len(valid_bins)
+
+    def body(carry, xs):
+        score, vscores = carry
+        rmask, fmask = xs
+        grad, hess = grad_fn(obj_params,
+                             score if num_class > 1 else score[0])
+        if num_class == 1:
+            grad, hess = grad[None], hess[None]
+        outs = []
+        vscores = list(vscores)
+        ones = (base_mask if base_mask is not None
+                else jnp.ones((N,), jnp.bool_))
+        for cls in range(num_class):
+            rm = (rmask[cls] & ones) if has_bag else ones
+            fm = fmask[cls] if has_ff else jnp.ones((F,), jnp.bool_)
+            ta = grow_fn(bins, grad[cls], hess[cls], rm, fm, num_bins)
+            shrunk = jnp.where(ta.num_leaves > 1, ta.leaf_value * lrf, 0.0)
+            score = score.at[cls].add(shrunk[ta.leaf_ids])
+            # valid scores by tree replay (gbdt.cpp:220-222)
+            for v in range(n_valid):
+                vscores[v] = vscores[v].at[cls].set(add_tree_score(
+                    valid_bins[v], vscores[v][cls], ta.split_feature,
+                    ta.threshold_bin, ta.left_child, ta.right_child,
+                    shrunk, ta.num_leaves, max_nodes=max_nodes))
+            outs.append(ta._replace(leaf_ids=jnp.zeros((0,), jnp.int32)))
+        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *outs)
+
+        # in-program metric evaluation (Metric::Eval on CPU threads in the
+        # reference; here the scores never leave the device)
+        mv = []
+        for f, p in zip(train_metric_fns, train_mparams):
+            mv.append(f(p, score if num_class > 1 else score[0]))
+        for v in range(n_valid):
+            sv = vscores[v] if num_class > 1 else vscores[v][0]
+            for f, p in zip(valid_metric_fns[v], valid_mparams[v]):
+                mv.append(f(p, sv))
+        mvals = jnp.concatenate(mv) if mv else jnp.zeros((0,), jnp.float32)
+        return (score, tuple(vscores)), (stacked, mvals)
+
+    return body
+
+
 def _get_chunk_program(obj_key, grad_fn, num_class: int, lr: float,
                        grow_policy: str, *, num_leaves: int,
                        num_bins_max: int, min_data_in_leaf: int,
@@ -801,52 +916,19 @@ def _get_chunk_program(obj_key, grad_fn, num_class: int, lr: float,
     else:
         from .grower import grow_tree_impl as grow
     lrf = jnp.float32(lr)
-    n_valid = len(valid_metric_fns)
     max_nodes = max(num_leaves - 1, 1)
 
     def chunk_fn(score, bins, num_bins, row_masks, feat_masks, obj_params,
                  train_mparams, valid_bins, valid_scores, valid_mparams):
-        F, N = bins.shape
-
-        def body(carry, xs):
-            score, vscores = carry
-            rmask, fmask = xs
-            grad, hess = grad_fn(obj_params,
-                                 score if num_class > 1 else score[0])
-            if num_class == 1:
-                grad, hess = grad[None], hess[None]
-            outs = []
-            vscores = list(vscores)
-            for cls in range(num_class):
-                rm = rmask[cls] if has_bag else jnp.ones((N,), jnp.bool_)
-                fm = fmask[cls] if has_ff else jnp.ones((F,), jnp.bool_)
-                ta = grow(bins, grad[cls], hess[cls], rm, fm, num_bins,
-                          **grower_kwargs)
-                shrunk = jnp.where(ta.num_leaves > 1,
-                                   ta.leaf_value * lrf, 0.0)
-                score = score.at[cls].add(shrunk[ta.leaf_ids])
-                # valid scores by tree replay (gbdt.cpp:220-222)
-                for v in range(n_valid):
-                    vscores[v] = vscores[v].at[cls].set(add_tree_score(
-                        valid_bins[v], vscores[v][cls], ta.split_feature,
-                        ta.threshold_bin, ta.left_child, ta.right_child,
-                        shrunk, ta.num_leaves, max_nodes=max_nodes))
-                outs.append(ta._replace(leaf_ids=jnp.zeros((0,), jnp.int32)))
-            stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *outs)
-
-            # in-program metric evaluation (Metric::Eval on CPU threads in
-            # the reference; here the scores never leave the device)
-            mv = []
-            for f, p in zip(train_metric_fns, train_mparams):
-                mv.append(f(p, score if num_class > 1 else score[0]))
-            for v in range(n_valid):
-                sv = vscores[v] if num_class > 1 else vscores[v][0]
-                for f, p in zip(valid_metric_fns[v], valid_mparams[v]):
-                    mv.append(f(p, sv))
-            mvals = (jnp.concatenate(mv) if mv
-                     else jnp.zeros((0,), jnp.float32))
-            return (score, tuple(vscores)), (stacked, mvals)
-
+        body = make_chunk_body(
+            grad_fn=grad_fn, obj_params=obj_params, num_class=num_class,
+            lrf=lrf,
+            grow_fn=lambda *a: grow(*a, **grower_kwargs),
+            has_bag=has_bag, has_ff=has_ff, bins=bins, num_bins=num_bins,
+            max_nodes=max_nodes, valid_bins=valid_bins,
+            valid_mparams=valid_mparams,
+            train_metric_fns=train_metric_fns, train_mparams=train_mparams,
+            valid_metric_fns=valid_metric_fns)
         (score, vscores), (stacked, mvals) = jax.lax.scan(
             body, (score, tuple(valid_scores)), (row_masks, feat_masks))
         return score, vscores, stacked, mvals

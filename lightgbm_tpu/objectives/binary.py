@@ -9,6 +9,9 @@ from ..utils import log
 
 
 class BinaryLogloss:
+    # chunk_params are all row-aligned [N, ...] arrays or scalars —
+    # shardable over the data axis for data-parallel chunked training
+    rows_aligned_params = True
     def __init__(self, config):
         self.is_unbalance = config.is_unbalance
         self._sigmoid = float(config.sigmoid)

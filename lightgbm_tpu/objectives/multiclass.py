@@ -9,6 +9,9 @@ from ..utils import log
 
 
 class MulticlassLogloss:
+    # chunk_params are all row-aligned [N, ...] arrays or scalars —
+    # shardable over the data axis for data-parallel chunked training
+    rows_aligned_params = True
     def __init__(self, config):
         self._num_class = int(config.num_class)
         self.weights = None

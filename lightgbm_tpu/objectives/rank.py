@@ -29,6 +29,9 @@ K_MIN_SCORE = -np.inf
 
 
 class LambdarankNDCG:
+    # per-query tables index the GLOBAL score vector; not shardable
+    # over the data axis (data-parallel chunking falls back)
+    rows_aligned_params = False
     def __init__(self, config):
         self._sigmoid = float(config.sigmoid)
         if self._sigmoid <= 0.0:

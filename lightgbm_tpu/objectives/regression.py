@@ -6,6 +6,9 @@ import jax.numpy as jnp
 
 
 class RegressionL2Loss:
+    # chunk_params are all row-aligned [N, ...] arrays or scalars —
+    # shardable over the data axis for data-parallel chunked training
+    rows_aligned_params = True
     def __init__(self, config):
         self.weights = None
 

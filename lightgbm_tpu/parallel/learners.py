@@ -102,8 +102,80 @@ class _ParallelLearnerBase:
                        "leafwise") == "depthwise"
 
 
+# Compiled data-parallel k-iteration chunk programs, shared process-wide
+# (keyed on static config only, like models/gbdt._CHUNK_PROGRAMS).
+_DP_CHUNK_PROGRAMS: dict = {}
+
+
 class DataParallelLearner(_ParallelLearnerBase):
     """Rows sharded; histograms psum'd (data_parallel_tree_learner.cpp)."""
+
+    def chunk_program(self, gbdt, obj_key, grad_fn, obj_params,
+                      has_bag: bool, has_ff: bool):
+        """Fused k-iteration training program under shard_map: the whole
+        gradients → grow(psum'd histograms) → score-update scan runs sharded
+        over the mesh, one dispatch per chunk (the data-parallel analog of
+        models/gbdt._get_chunk_program; no in-program eval — the chunked
+        eval path is serial-only).
+
+        Returns (program, num_shards).  The caller pads rows to a multiple
+        of num_shards and passes ``valid_rows`` (False on padding) so padded
+        rows never enter histograms or root stats."""
+        mesh = get_mesh(self.config.network_config.num_machines, DATA_AXIS)
+        num_shards = mesh.shape[DATA_AXIS]
+        num_class = gbdt.num_class
+        lr = float(gbdt.gbdt_config.learning_rate)
+        kwargs = self._grow_kwargs(gbdt)
+        depthwise = self._depthwise
+        key = (obj_key, id(grad_fn), num_shards, num_class, lr, depthwise,
+               tuple(sorted(kwargs.items())), has_bag, has_ff)
+        prog = _DP_CHUNK_PROGRAMS.get(key)
+        if prog is not None:
+            return prog, num_shards
+
+        grow = grow_tree_depthwise if depthwise else grow_tree_impl
+        if depthwise:
+            kwargs = dict(kwargs, compact_rows=False)
+        lrf = jnp.float32(lr)
+
+        def shard_chunk(score, bins, num_bins, valid_rows, row_masks,
+                        feat_masks, obj_params):
+            from ..models.gbdt import make_chunk_body
+            body = make_chunk_body(
+                grad_fn=grad_fn, obj_params=obj_params, num_class=num_class,
+                lrf=lrf,
+                grow_fn=lambda *a: grow(
+                    *a,
+                    hist_reduce=lambda h: jax.lax.psum(h, DATA_AXIS),
+                    stat_reduce=lambda s: jax.lax.psum(s, DATA_AXIS),
+                    **kwargs),
+                has_bag=has_bag, has_ff=has_ff, bins=bins,
+                num_bins=num_bins, base_mask=valid_rows)
+
+            def body2(score, xs):
+                (score, _), (stacked, _) = body((score, ()), xs)
+                return score, stacked
+
+            return jax.lax.scan(body2, score, (row_masks, feat_masks))
+
+        def param_spec(leaf):
+            # row-aligned arrays ride the data axis; scalars are replicated
+            # (objectives with non-row tables — lambdarank — are excluded by
+            # the caller's dp-chunkable gate)
+            if getattr(leaf, "ndim", 0) >= 1:
+                return P(DATA_AXIS, *([None] * (leaf.ndim - 1)))
+            return P()
+
+        pspecs = jax.tree.map(param_spec, obj_params)
+        prog = jax.jit(shard_map(
+            shard_chunk, mesh=mesh,
+            in_specs=(P(None, DATA_AXIS), P(None, DATA_AXIS), P(),
+                      P(DATA_AXIS),
+                      P(None, None, DATA_AXIS) if has_bag else P(),
+                      P(), pspecs),
+            out_specs=(P(None, DATA_AXIS), _tree_out_specs(None))))
+        _DP_CHUNK_PROGRAMS[key] = prog
+        return prog, num_shards
 
     def __call__(self, gbdt, bins, grad, hess, row_mask, feature_mask):
         mesh = get_mesh(self.config.network_config.num_machines, DATA_AXIS)
