@@ -103,6 +103,18 @@ class Dataset:
 
         parser = parser_mod.create_parser(io_config.data_filename,
                                           io_config.has_header, 0, label_idx)
+        if io_config.use_two_round_loading:
+            # streaming two-pass load (dataset.cpp two-round path): never
+            # materializes the [N, F] float64 matrix — pass 1 samples rows
+            # for binning and collects labels/side columns, pass 2
+            # quantizes chunks straight into the bin matrix
+            self._load_train_two_round(
+                io_config, parser, rank, num_machines, predict_fun,
+                bin_finder, weight_idx, group_idx, ignore_set, header_names)
+            self.metadata.finalize(self.num_data)
+            if io_config.is_save_binary_file:
+                self.save_binary(bin_path)
+            return self
         lines = parser_mod.read_lines(io_config.data_filename,
                                       skip_header=io_config.has_header)
         parsed = parser.parse(lines)
@@ -201,6 +213,166 @@ class Dataset:
         if io_config.is_save_binary_file:
             self.save_binary(bin_path)
         return self
+
+    def _load_train_two_round(self, io_config, parser, rank, num_machines,
+                              predict_fun, bin_finder, weight_idx, group_idx,
+                              ignore_set, header_names) -> None:
+        """Streaming two-pass training load (``use_two_round_loading``,
+        dataset.cpp:430-452 / text_reader SampleFromFile): peak host memory
+        is one parse chunk plus the ≤50k-row bin-finding sample plus the
+        int8/int16 bin matrix — never the full float64 feature matrix."""
+        chunk_rows = 200_000
+        rng_sample = np.random.RandomState(io_config.data_random_seed)
+
+        # ---- pass 1: count rows, reservoir-sample for binning, collect
+        # labels and in-file weight/query columns.  The reservoir is a
+        # preallocated matrix COPIED into — retaining views of chunk rows
+        # would pin every chunk's full float64 array and defeat the memory
+        # bound this path exists for
+        reservoir = None          # [SAMPLE_CNT, F] float64
+        labels_parts, weight_parts, group_parts = [], [], []
+        total_rows = 0
+        num_cols = None
+        for lines in parser_mod.read_line_chunks(
+                io_config.data_filename, skip_header=io_config.has_header,
+                chunk_lines=chunk_rows):
+            parsed = parser.parse(lines)
+            feats = parsed.features
+            num_cols = feats.shape[1]
+            if reservoir is None:
+                reservoir = np.empty((SAMPLE_CNT, num_cols), np.float64)
+            labels_parts.append(parsed.labels)
+            if weight_idx >= 0:
+                weight_parts.append(feats[:, weight_idx].astype(np.float32))
+            if group_idx >= 0:
+                group_parts.append(feats[:, group_idx].copy())
+            # algorithm-R reservoir, vectorized per chunk (utils/random.h
+            # Sample semantics: every row equally likely)
+            c = feats.shape[0]
+            global_idx = total_rows + np.arange(c)
+            if total_rows < SAMPLE_CNT:
+                take = min(SAMPLE_CNT - total_rows, c)
+                reservoir[total_rows:total_rows + take] = feats[:take]
+                start = take
+            else:
+                start = 0
+            if start < c:
+                accept = (rng_sample.rand(c - start)
+                          < SAMPLE_CNT / (global_idx[start:] + 1.0))
+                for i in np.nonzero(accept)[0]:
+                    reservoir[rng_sample.randint(SAMPLE_CNT)] = \
+                        feats[start + i]
+            total_rows += c
+        self.global_num_data = total_rows
+        sample = (reservoir[:min(total_rows, SAMPLE_CNT)]
+                  if reservoir is not None
+                  else np.zeros((0, 0), np.float64))
+
+        all_labels = np.concatenate(labels_parts) if labels_parts else \
+            np.zeros((0,), np.float32)
+        self.num_total_features = num_cols or 0
+        self.feature_names = _make_feature_names(header_names,
+                                                 self.label_idx,
+                                                 self.num_total_features)
+
+        # distributed row sharding mask (dataset.cpp:172-216)
+        if group_idx >= 0:
+            log.info("using query id in data file, and ignore additional "
+                     "query file")
+            self.metadata.query_boundaries = None
+            self.metadata.set_queries_from_column(
+                np.concatenate(group_parts))
+        if num_machines > 1 and not io_config.is_pre_partition:
+            rng = np.random.RandomState(io_config.data_random_seed)
+            if self.metadata.query_boundaries is not None:
+                nq = self.metadata.num_queries
+                q_owner = rng.randint(0, num_machines, size=nq)
+                row_query = np.searchsorted(self.metadata.query_boundaries,
+                                            np.arange(total_rows),
+                                            side="right") - 1
+                mask = q_owner[row_query] == rank
+            else:
+                mask = rng.randint(0, num_machines,
+                                   size=total_rows) == rank
+            self.used_data_indices = np.nonzero(mask)[0].astype(np.int64)
+        else:
+            mask = None
+            self.used_data_indices = None
+
+        # bin mappers from the sample (local or distributed)
+        if bin_finder is not None:
+            raw_mappers = bin_finder(sample, io_config.max_bin)
+        else:
+            raw_mappers = []
+            for j in range(self.num_total_features):
+                if j in ignore_set:
+                    raw_mappers.append(None)
+                    continue
+                m = BinMapper()
+                m.find_bin(sample[:, j], io_config.max_bin)
+                raw_mappers.append(m)
+        for j, mapper in enumerate(raw_mappers):
+            if mapper is None or j in ignore_set:
+                continue
+            if mapper.is_trivial:
+                log.warning("Feature %s only contains one value, will be "
+                            "ignored" % self.feature_names[j])
+                continue
+            self.used_feature_map[j] = len(self.bin_mappers)
+            self.bin_mappers.append(mapper)
+        self.real_feature_idx = np.array(sorted(self.used_feature_map),
+                                         dtype=np.int32)
+        self.num_bins = np.array([m.num_bin for m in self.bin_mappers],
+                                 dtype=np.int32)
+        del sample
+
+        if weight_idx >= 0:
+            log.info("using weight in data file, and ignore additional "
+                     "weight file")
+            self.metadata.weights = np.concatenate(weight_parts)
+
+        self.metadata.set_label(all_labels)
+        if self.used_data_indices is not None:
+            if self.metadata.queries is not None:
+                self.metadata.queries = \
+                    self.metadata.queries[self.used_data_indices]
+            self.metadata.partition(self.used_data_indices, total_rows)
+            self.num_data = len(self.used_data_indices)
+        else:
+            self.num_data = total_rows
+
+        # ---- pass 2: quantize chunks straight into the bin matrix
+        dtype = _bin_dtype(int(self.num_bins.max())
+                           if len(self.bin_mappers) else 256)
+        bins = np.empty((len(self.bin_mappers), self.num_data), dtype=dtype)
+        init_scores = [] if predict_fun is not None else None
+        cursor = 0
+        start = 0
+        for lines in parser_mod.read_line_chunks(
+                io_config.data_filename, skip_header=io_config.has_header,
+                chunk_lines=chunk_rows):
+            feats = parser.parse(lines).features
+            c = feats.shape[0]
+            if mask is not None:
+                feats = feats[mask[start:start + c]]
+            n = feats.shape[0]
+            for j_raw, j_inner in self.used_feature_map.items():
+                bins[j_inner, cursor:cursor + n] = \
+                    self.bin_mappers[j_inner].value_to_bin(
+                        feats[:, j_raw]).astype(dtype)
+            if init_scores is not None:
+                init_scores.append(np.asarray(predict_fun(feats),
+                                              np.float32).reshape(-1))
+            cursor += n
+            start += c
+        # the file could change between the two streaming passes; a size
+        # mismatch must be a hard error, not uninitialized bin memory
+        log.check(start == total_rows and cursor == self.num_data,
+                  "Input file changed between the two loading passes "
+                  f"(pass 1: {total_rows} rows, pass 2: {start})")
+        self.bins = bins
+        if init_scores is not None:
+            self.metadata.init_score = np.concatenate(init_scores)
 
     @classmethod
     def load_valid(cls, train: "Dataset", filename: str,

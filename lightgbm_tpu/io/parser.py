@@ -284,3 +284,23 @@ def read_lines(filename: str, skip_header: bool = False) -> List[str]:
     if skip_header and lines:
         lines = lines[1:]
     return [ln for ln in lines if ln]
+
+
+def read_line_chunks(filename: str, skip_header: bool = False,
+                     chunk_lines: int = 200_000):
+    """Stream data lines in bounded chunks (TextReader's 16MB-block
+    pipelined reads, utils/text_reader.h:248-281) — the two-round loading
+    path's memory bound."""
+    with open(filename, "r") as f:
+        if skip_header:
+            f.readline()
+        buf: List[str] = []
+        for line in f:
+            line = line.rstrip("\n")
+            if line:
+                buf.append(line)
+                if len(buf) >= chunk_lines:
+                    yield buf
+                    buf = []
+        if buf:
+            yield buf
