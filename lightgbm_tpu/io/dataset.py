@@ -126,20 +126,9 @@ class Dataset:
 
         # distributed row sharding at load time (dataset.cpp:172-216):
         # random per-record assignment, query-atomic when queries exist
-        if num_machines > 1 and not io_config.is_pre_partition:
-            rng = np.random.RandomState(io_config.data_random_seed)
-            if self.metadata.query_boundaries is not None:
-                nq = self.metadata.num_queries
-                q_owner = rng.randint(0, num_machines, size=nq)
-                row_query = np.searchsorted(self.metadata.query_boundaries,
-                                            np.arange(total_rows),
-                                            side="right") - 1
-                mask = q_owner[row_query] == rank
-            else:
-                mask = rng.randint(0, num_machines, size=total_rows) == rank
-            self.used_data_indices = np.nonzero(mask)[0].astype(np.int64)
-        else:
-            self.used_data_indices = None
+        self.used_data_indices = self._draw_shard_mask(io_config, rank,
+                                                       num_machines,
+                                                       total_rows)
 
         # sample ≤50k global rows for bin finding (dataset.cpp:218-273)
         rng = np.random.RandomState(io_config.data_random_seed)
@@ -153,35 +142,9 @@ class Dataset:
         self.feature_names = _make_feature_names(header_names, label_idx,
                                                  self.num_total_features)
 
-        # bin mappers for every raw feature column
-        if bin_finder is not None:
-            raw_mappers = bin_finder(sample, io_config.max_bin)
-        else:
-            raw_mappers = []
-            for j in range(self.num_total_features):
-                if j in ignore_set:
-                    raw_mappers.append(None)
-                    continue
-                m = BinMapper()
-                m.find_bin(sample[:, j], io_config.max_bin)
-                raw_mappers.append(m)
-
-        # trivial/ignored feature removal (dataset.cpp:334-350)
-        for j, mapper in enumerate(raw_mappers):
-            if mapper is None or j in ignore_set:
-                if j not in ignore_set:
-                    log.warning("Ignore Feature %s" % self.feature_names[j])
-                continue
-            if mapper.is_trivial:
-                log.warning("Feature %s only contains one value, will be ignored"
-                            % self.feature_names[j])
-                continue
-            self.used_feature_map[j] = len(self.bin_mappers)
-            self.bin_mappers.append(mapper)
-        self.real_feature_idx = np.array(sorted(self.used_feature_map),
-                                         dtype=np.int32)
-        self.num_bins = np.array([m.num_bin for m in self.bin_mappers],
-                                 dtype=np.int32)
+        # bin mappers + trivial/ignored feature removal (dataset.cpp:334-350)
+        self._build_bin_mappers(sample, io_config.max_bin, bin_finder,
+                                ignore_set)
 
         # capture weight/group columns from the data file (overrides side
         # files, ExtractFeaturesFromMemory dataset.cpp:536-545)
@@ -213,6 +176,57 @@ class Dataset:
         if io_config.is_save_binary_file:
             self.save_binary(bin_path)
         return self
+
+    def _draw_shard_mask(self, io_config, rank, num_machines, total_rows):
+        """Distributed row sharding at load time (dataset.cpp:172-216):
+        random per-record assignment, query-atomic when query boundaries
+        exist (at this point: from side files — in-file group columns
+        override boundaries only AFTER sharding, matching the one-round
+        order of operations).  Returns used row indices or None."""
+        if num_machines <= 1 or io_config.is_pre_partition:
+            return None
+        rng = np.random.RandomState(io_config.data_random_seed)
+        if self.metadata.query_boundaries is not None:
+            nq = self.metadata.num_queries
+            q_owner = rng.randint(0, num_machines, size=nq)
+            row_query = np.searchsorted(self.metadata.query_boundaries,
+                                        np.arange(total_rows),
+                                        side="right") - 1
+            mask = q_owner[row_query] == rank
+        else:
+            mask = rng.randint(0, num_machines, size=total_rows) == rank
+        return np.nonzero(mask)[0].astype(np.int64)
+
+    def _build_bin_mappers(self, sample, max_bin, bin_finder,
+                           ignore_set) -> None:
+        """Bin mappers for every raw feature column plus trivial/ignored
+        feature removal (dataset.cpp:275-350)."""
+        if bin_finder is not None:
+            raw_mappers = bin_finder(sample, max_bin)
+        else:
+            raw_mappers = []
+            for j in range(self.num_total_features):
+                if j in ignore_set:
+                    raw_mappers.append(None)
+                    continue
+                m = BinMapper()
+                m.find_bin(sample[:, j], max_bin)
+                raw_mappers.append(m)
+        for j, mapper in enumerate(raw_mappers):
+            if mapper is None or j in ignore_set:
+                if j not in ignore_set:
+                    log.warning("Ignore Feature %s" % self.feature_names[j])
+                continue
+            if mapper.is_trivial:
+                log.warning("Feature %s only contains one value, will be "
+                            "ignored" % self.feature_names[j])
+                continue
+            self.used_feature_map[j] = len(self.bin_mappers)
+            self.bin_mappers.append(mapper)
+        self.real_feature_idx = np.array(sorted(self.used_feature_map),
+                                         dtype=np.int32)
+        self.num_bins = np.array([m.num_bin for m in self.bin_mappers],
+                                 dtype=np.int32)
 
     def _load_train_two_round(self, io_config, parser, rank, num_machines,
                               predict_fun, bin_finder, weight_idx, group_idx,
@@ -275,55 +289,27 @@ class Dataset:
                                                  self.label_idx,
                                                  self.num_total_features)
 
-        # distributed row sharding mask (dataset.cpp:172-216)
+        # distributed row sharding mask BEFORE the in-file group column
+        # overrides query boundaries — the one-round path's order (side-file
+        # boundaries drive query-atomic sharding; the group column is
+        # extracted later, dataset.cpp:536-545)
+        self.used_data_indices = self._draw_shard_mask(io_config, rank,
+                                                       num_machines,
+                                                       total_rows)
+        mask = None
+        if self.used_data_indices is not None:
+            mask = np.zeros(total_rows, dtype=bool)
+            mask[self.used_data_indices] = True
         if group_idx >= 0:
             log.info("using query id in data file, and ignore additional "
                      "query file")
             self.metadata.query_boundaries = None
             self.metadata.set_queries_from_column(
                 np.concatenate(group_parts))
-        if num_machines > 1 and not io_config.is_pre_partition:
-            rng = np.random.RandomState(io_config.data_random_seed)
-            if self.metadata.query_boundaries is not None:
-                nq = self.metadata.num_queries
-                q_owner = rng.randint(0, num_machines, size=nq)
-                row_query = np.searchsorted(self.metadata.query_boundaries,
-                                            np.arange(total_rows),
-                                            side="right") - 1
-                mask = q_owner[row_query] == rank
-            else:
-                mask = rng.randint(0, num_machines,
-                                   size=total_rows) == rank
-            self.used_data_indices = np.nonzero(mask)[0].astype(np.int64)
-        else:
-            mask = None
-            self.used_data_indices = None
 
         # bin mappers from the sample (local or distributed)
-        if bin_finder is not None:
-            raw_mappers = bin_finder(sample, io_config.max_bin)
-        else:
-            raw_mappers = []
-            for j in range(self.num_total_features):
-                if j in ignore_set:
-                    raw_mappers.append(None)
-                    continue
-                m = BinMapper()
-                m.find_bin(sample[:, j], io_config.max_bin)
-                raw_mappers.append(m)
-        for j, mapper in enumerate(raw_mappers):
-            if mapper is None or j in ignore_set:
-                continue
-            if mapper.is_trivial:
-                log.warning("Feature %s only contains one value, will be "
-                            "ignored" % self.feature_names[j])
-                continue
-            self.used_feature_map[j] = len(self.bin_mappers)
-            self.bin_mappers.append(mapper)
-        self.real_feature_idx = np.array(sorted(self.used_feature_map),
-                                         dtype=np.int32)
-        self.num_bins = np.array([m.num_bin for m in self.bin_mappers],
-                                 dtype=np.int32)
+        self._build_bin_mappers(sample, io_config.max_bin, bin_finder,
+                                ignore_set)
         del sample
 
         if weight_idx >= 0:

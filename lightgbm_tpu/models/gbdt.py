@@ -686,13 +686,87 @@ class GBDT:
 
     # ------------------------------------------------------------ prediction
 
+    # device batch prediction pays ~one dispatch of link latency; below this
+    # rows x trees volume the host numpy walk wins
+    _DEVICE_PREDICT_THRESHOLD = 20_000_000
+
+    def _device_predict_encode(self, features: np.ndarray, models):
+        """Rank-encode raw feature values against the union of the
+        ensemble's thresholds, in float64 on host — the integer replay on
+        device then routes rows EXACTLY like the reference's double
+        comparisons (tree.h:163-175), with no f32 threshold rounding."""
+        max_nodes = max(max((t.num_leaves - 1 for t in models), default=1), 1)
+        T = len(models)
+        used = sorted({int(f) for t in models
+                       for f in t.split_feature_real[:t.num_leaves - 1]})
+        fmap = {f: i for i, f in enumerate(used)}
+        thr = {f: [] for f in used}
+        for t in models:
+            for f, v in zip(t.split_feature_real, t.threshold):
+                thr[int(f)].append(float(v))
+        thr = {f: np.unique(np.asarray(v, np.float64)) for f, v in thr.items()}
+
+        N = features.shape[0]
+        codes = np.zeros((max(len(used), 1), N), np.int32)
+        for f, i in fmap.items():
+            # code = #{thresholds < x}; x > t_j  <=>  code > j, and an exact
+            # tie x == t_j gives code == j -> left, matching `value > t`
+            vals = features[:, f]
+            c = np.searchsorted(thr[f], vals, side="left")
+            # NaN sorts past every threshold; the host walk's `value > t`
+            # is False for NaN -> always left.  Match it.
+            c[np.isnan(vals)] = 0
+            codes[i] = c
+
+        sf = np.zeros((T, max_nodes), np.int32)
+        tr = np.zeros((T, max_nodes), np.int32)
+        lc = np.zeros((T, max_nodes), np.int32)
+        rc = np.zeros((T, max_nodes), np.int32)
+        lv = np.zeros((T, max_nodes + 1), np.float32)
+        nl = np.zeros((T,), np.int32)
+        for k, t in enumerate(models):
+            n = t.num_leaves - 1
+            nl[k] = t.num_leaves
+            lv[k, :t.num_leaves] = t.leaf_value
+            if n <= 0:
+                continue
+            sf[k, :n] = [fmap[int(f)] for f in t.split_feature_real[:n]]
+            tr[k, :n] = [int(np.searchsorted(thr[int(f)], float(v), "left"))
+                         for f, v in zip(t.split_feature_real[:n],
+                                         t.threshold[:n])]
+            lc[k, :n] = t.left_child[:n]
+            rc[k, :n] = t.right_child[:n]
+        return codes, (sf, tr, lc, rc, lv, nl), max_nodes
+
+    def _predict_scores_device(self, features: np.ndarray,
+                               models) -> np.ndarray:
+        """[num_class, N] raw ensemble sums on device (chunked rows)."""
+        from ..ops.scoring import ensemble_scores
+        codes, (sf, tr, lc, rc, lv, nl), max_nodes = \
+            self._device_predict_encode(features, models)
+        tc = jnp.asarray(np.arange(len(models)) % self.num_class, jnp.int32)
+        args = tuple(jnp.asarray(a) for a in (sf, tr, lc, rc, lv, nl))
+        N = features.shape[0]
+        chunk = 1 << 19
+        outs = []
+        for s in range(0, N, chunk):
+            out = ensemble_scores(jnp.asarray(codes[:, s:s + chunk]), *args,
+                                  tc, max_nodes=max_nodes,
+                                  num_class=self.num_class)
+            outs.append(np.asarray(out, np.float64))
+        return np.concatenate(outs, axis=1)
+
     def predict_raw(self, features: np.ndarray,
                     num_used_model: int = -1) -> np.ndarray:
         """Batch PredictRaw (gbdt.cpp:470-479); features [N, cols] raw."""
         if num_used_model < 0:
             num_used_model = len(self.models)
+        models = self.models[:num_used_model]
+        if features.shape[0] * max(len(models), 1) \
+                >= self._DEVICE_PREDICT_THRESHOLD:
+            return self._predict_scores_device(features, models)[0]
         out = np.zeros(features.shape[0], dtype=np.float64)
-        for tree in self.models[:num_used_model]:
+        for tree in models:
             out += tree.predict(features)
         return out
 
@@ -709,10 +783,17 @@ class GBDT:
         """[N, num_class] softmax probabilities (gbdt.cpp:496-508)."""
         if num_used_model < 0:
             num_used_model = len(self.models) // self.num_class
-        out = np.zeros((features.shape[0], self.num_class), dtype=np.float64)
-        for i in range(num_used_model):
-            for j in range(self.num_class):
-                out[:, j] += self.models[i * self.num_class + j].predict(features)
+        models = self.models[:num_used_model * self.num_class]
+        if features.shape[0] * max(len(models), 1) \
+                >= self._DEVICE_PREDICT_THRESHOLD:
+            out = self._predict_scores_device(features, models).T
+        else:
+            out = np.zeros((features.shape[0], self.num_class),
+                           dtype=np.float64)
+            for i in range(num_used_model):
+                for j in range(self.num_class):
+                    out[:, j] += self.models[i * self.num_class
+                                             + j].predict(features)
         z = out - out.max(axis=1, keepdims=True)
         p = np.exp(z)
         return p / p.sum(axis=1, keepdims=True)
@@ -722,8 +803,24 @@ class GBDT:
         """[N, num_models] leaf indices (gbdt.cpp:510-519)."""
         if num_used_model < 0:
             num_used_model = len(self.models)
+        models = self.models[:num_used_model]
+        if features.shape[0] * max(len(models), 1) \
+                >= self._DEVICE_PREDICT_THRESHOLD:
+            from ..ops.scoring import ensemble_leaf_indices
+            codes, (sf, tr, lc, rc, _, nl), max_nodes = \
+                self._device_predict_encode(features, models)
+            args = tuple(jnp.asarray(a) for a in (sf, tr, lc, rc, nl))
+            N = features.shape[0]
+            chunk = 1 << 19
+            outs = []
+            for s in range(0, N, chunk):
+                leaves = ensemble_leaf_indices(
+                    jnp.asarray(codes[:, s:s + chunk]), *args,
+                    max_nodes=max_nodes)
+                outs.append(np.asarray(leaves, np.int32).T)
+            return np.concatenate(outs, axis=0)
         cols = []
-        for tree in self.models[:num_used_model]:
+        for tree in models:
             if tree.num_leaves == 1:
                 cols.append(np.zeros(features.shape[0], dtype=np.int32))
             else:

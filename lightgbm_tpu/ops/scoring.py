@@ -83,6 +83,59 @@ def split_leaf_sequence(left_child: jax.Array, right_child: jax.Array,
     return jax.lax.fori_loop(0, L1, fill, split_leaf)
 
 
+@functools.partial(jax.jit, static_argnames=("max_nodes", "num_class"))
+def ensemble_scores(codes: jax.Array, split_feature: jax.Array,
+                    threshold_rank: jax.Array, left_child: jax.Array,
+                    right_child: jax.Array, leaf_value: jax.Array,
+                    num_leaves: jax.Array, tree_class: jax.Array,
+                    *, max_nodes: int, num_class: int) -> jax.Array:
+    """Batch ensemble prediction: Σ over trees of tree(codes rows), summed
+    per class (GBDT::PredictRaw / Predictor batch loop,
+    gbdt.cpp:470-519 + predictor.hpp:109-197, as ONE device scan).
+
+    ``codes`` is the integer rank encoding of raw feature values against
+    the union of the ensemble's own thresholds (built on host in f64), so
+    routing is EXACT — no f32 threshold-comparison rounding.  Per-tree
+    arrays are stacked [T, ...]; returns [num_class, N] raw score sums.
+    """
+    N = codes.shape[1]
+
+    def body(score, xs):
+        sf, tr, lc, rc, lv, nl, tc = xs
+        split_leaf = split_leaf_sequence(lc, rc, max_nodes + 1,
+                                         num_nodes=nl - 1)
+        leaf = leaf_ids_by_replay(codes, sf, tr, split_leaf, nl - 1,
+                                  max_nodes=max_nodes)
+        return score.at[tc].add(lv[leaf]), None
+
+    init = jnp.zeros((num_class, N), jnp.float32)
+    score, _ = jax.lax.scan(
+        body, init, (split_feature, threshold_rank, left_child, right_child,
+                     leaf_value, num_leaves, tree_class))
+    return score
+
+
+@functools.partial(jax.jit, static_argnames=("max_nodes",))
+def ensemble_leaf_indices(codes: jax.Array, split_feature: jax.Array,
+                          threshold_rank: jax.Array, left_child: jax.Array,
+                          right_child: jax.Array, num_leaves: jax.Array,
+                          *, max_nodes: int) -> jax.Array:
+    """[T, N] leaf index per tree (PredictLeafIndex, gbdt.cpp:510-519)."""
+
+    def body(_, xs):
+        sf, tr, lc, rc, nl = xs
+        split_leaf = split_leaf_sequence(lc, rc, max_nodes + 1,
+                                         num_nodes=nl - 1)
+        leaf = leaf_ids_by_replay(codes, sf, tr, split_leaf, nl - 1,
+                                  max_nodes=max_nodes)
+        return None, leaf
+
+    _, leaves = jax.lax.scan(
+        body, None, (split_feature, threshold_rank, left_child, right_child,
+                     num_leaves))
+    return leaves
+
+
 @functools.partial(jax.jit, static_argnames=("max_nodes",))
 def add_tree_score(bins: jax.Array, score: jax.Array,
                    split_feature: jax.Array, threshold_bin: jax.Array,

@@ -421,3 +421,66 @@ def test_chunked_early_stopping_matches_per_iter(synthetic_binary):
     for t1, t2 in zip(b1.models, b2.models):
         np.testing.assert_array_equal(t1.split_feature, t2.split_feature)
     np.testing.assert_array_equal(b1.best_iter[0], b2.best_iter[0])
+
+
+def test_device_batch_prediction_exact(synthetic_binary):
+    """The device ensemble predictor (rank-encoded thresholds + integer
+    replay) must route every row exactly like the host float64 tree walk."""
+    x, y = synthetic_binary
+    booster, ds = _train(x, y, dict(BASE, num_iterations=8))
+    models = booster.models
+    host = np.zeros(x.shape[0])
+    for t in models:
+        host += t.predict(x)
+    dev = booster._predict_scores_device(x, models)[0]
+    # same leaves -> identical sums up to f32 accumulation of leaf values
+    np.testing.assert_allclose(dev, host, rtol=1e-5, atol=1e-6)
+
+    # threshold gate: force the device path through the public API
+    old = booster._DEVICE_PREDICT_THRESHOLD
+    try:
+        GBDT = type(booster)
+        GBDT._DEVICE_PREDICT_THRESHOLD = 1
+        via_api = booster.predict_raw(x)
+    finally:
+        GBDT._DEVICE_PREDICT_THRESHOLD = old
+    np.testing.assert_allclose(via_api, host, rtol=1e-5, atol=1e-6)
+
+    # values exactly ON a threshold route left identically
+    t0 = models[0]
+    f0 = int(t0.split_feature_real[0])
+    xe = x[:64].copy()
+    xe[:, f0] = t0.threshold[0]          # exact tie with the threshold
+    host_e = np.zeros(64)
+    for t in models:
+        host_e += t.predict(xe)
+    dev_e = booster._predict_scores_device(xe, models)[0]
+    np.testing.assert_allclose(dev_e, host_e, rtol=1e-5, atol=1e-6)
+
+
+def test_device_prediction_nan_routes_left(synthetic_binary):
+    """NaN feature values must route left on the device path exactly like
+    the host walk's `value > threshold` (False for NaN)."""
+    x, y = synthetic_binary
+    booster, _ = _train(x, y, dict(BASE, num_iterations=4))
+    xe = x[:128].copy()
+    xe[:, :3] = np.nan
+    host = np.zeros(128)
+    for t in booster.models:
+        host += t.predict(xe)
+    dev = booster._predict_scores_device(xe, booster.models)[0]
+    np.testing.assert_allclose(dev, host, rtol=1e-5, atol=1e-6)
+
+
+def test_device_leaf_index_matches_host(synthetic_binary):
+    x, y = synthetic_binary
+    booster, _ = _train(x, y, dict(BASE, num_iterations=4))
+    host = booster.predict_leaf_index(x)
+    from lightgbm_tpu.models.gbdt import GBDT as _G
+    old = _G._DEVICE_PREDICT_THRESHOLD
+    try:
+        _G._DEVICE_PREDICT_THRESHOLD = 1
+        dev = booster.predict_leaf_index(x)
+    finally:
+        _G._DEVICE_PREDICT_THRESHOLD = old
+    np.testing.assert_array_equal(host, dev)
