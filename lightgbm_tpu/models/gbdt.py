@@ -316,12 +316,12 @@ class GBDT:
                     return False
         return True
 
-    def chunkable_for(self, is_eval: bool) -> bool:
-        """Chunking decision for run_training.  The serial learner chunks
-        with full eval support (supports_chunking); the data-parallel
-        learner chunks only eval-free runs with row-shardable objective
-        state (metric evaluation under shard_map — AUC's global sort — is
-        not implemented)."""
+    def chunk_supported(self, is_eval: bool) -> bool:
+        """Whether train_chunk can run at all: serial learner with full
+        eval support (supports_chunking), or the data-parallel learner on
+        eval-free runs with row-shardable objective state (metric
+        evaluation under shard_map — AUC's global sort — is not
+        implemented)."""
         if self.supports_chunking:
             return True
         from ..parallel.learners import DataParallelLearner
@@ -335,6 +335,17 @@ class GBDT:
                      or self.early_stopping_round > 0))
             return not needs_eval
         return False
+
+    def chunkable_for(self, is_eval: bool) -> bool:
+        """run_training's chunking decision: chunk_supported AND the
+        depthwise grower.  Wrapping the leaf-wise grower's 254-split
+        fori_loop in the k-iteration scan crashes the TPU runtime at
+        production shapes (observed: 500k rows x 255 leaves x k>=4 kills
+        the worker; k<=2 survives), so run_training keeps leaf-wise on the
+        known-good per-iteration path; direct train_chunk calls remain
+        available for leaf-wise (used by CPU tests)."""
+        return (self.chunk_supported(is_eval)
+                and self.tree_config.grow_policy == "depthwise")
 
     def _metric_spec(self, metric):
         """Cached device_spec per metric instance (NDCG builds large padded
@@ -372,12 +383,12 @@ class GBDT:
         iteration i similarly rolls back to i+1 kept iterations before the
         reference's model pop-back.
         """
-        if not self.chunkable_for(is_eval):
+        if not self.chunk_supported(is_eval):
             raise RuntimeError(
                 "train_chunk requires a chunk-traceable objective and either "
                 "the serial learner (with device-capable metrics) or the "
                 "data-parallel learner without eval consumers (see "
-                "chunkable_for); use train_one_iter / run_training")
+                "chunk_supported); use train_one_iter / run_training")
         has_bag = self._use_bagging
         has_ff = self.tree_config.feature_fraction < 1.0
         obj_key, obj_params, grad_fn = self.objective.chunk_spec()
@@ -407,7 +418,7 @@ class GBDT:
             fn = _get_chunk_program(
                 obj_key, grad_fn, self.num_class,
                 float(self.gbdt_config.learning_rate),
-                getattr(self.tree_config, "grow_policy", "leafwise"),
+                self.tree_config.grow_policy,
                 num_leaves=_effective_num_leaves(self.tree_config),
                 num_bins_max=self.num_bins_max,
                 min_data_in_leaf=self.tree_config.min_data_in_leaf,
@@ -1044,7 +1055,7 @@ def _serial_learner(gbdt: GBDT, bins, grad, hess, row_mask, feature_mask):
         min_data_in_leaf=gbdt.tree_config.min_data_in_leaf,
         min_sum_hessian_in_leaf=gbdt.tree_config.min_sum_hessian_in_leaf,
         max_depth=gbdt.tree_config.max_depth)
-    if getattr(gbdt.tree_config, "grow_policy", "leafwise") == "depthwise":
+    if gbdt.tree_config.grow_policy == "depthwise":
         from .grower_depthwise import grow_tree_depthwise_jit
         return grow_tree_depthwise_jit(bins, grad, hess, row_mask,
                                        feature_mask, gbdt.num_bins_device,

@@ -98,8 +98,7 @@ class _ParallelLearnerBase:
 
     @property
     def _depthwise(self) -> bool:
-        return getattr(self.tree_config, "grow_policy",
-                       "leafwise") == "depthwise"
+        return self.tree_config.grow_policy == "depthwise"
 
 
 # Compiled data-parallel k-iteration chunk programs, shared process-wide

@@ -302,10 +302,13 @@ def test_run_training_tail_truncation(synthetic_binary):
     full-size program and rolled back — models, iter, score and RNG must
     match the per-iteration path."""
     x, y = synthetic_binary
+    # depthwise: run_training only chunks the depthwise policy (the
+    # leaf-wise fori_loop inside the scan crashes the TPU runtime)
     params = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 20,
               "min_sum_hessian_in_leaf": 1.0, "num_iterations": 5,
               "learning_rate": 0.2, "bagging_fraction": 0.8,
-              "bagging_freq": 2, "bagging_seed": 9, "feature_fraction": 0.7}
+              "bagging_freq": 2, "bagging_seed": 9, "feature_fraction": 0.7,
+              "grow_policy": "depthwise"}
     # chunk_size=4 < num_iterations=5 so the chunked branch runs: one full
     # chunk then a tail chunk(4, limit=1) exercising the rollback path
     ds = Dataset.from_arrays(x, y, max_bin=64)
@@ -365,7 +368,7 @@ def test_chunked_eval_matches_per_iter(synthetic_binary):
     x, y = synthetic_binary
     xt, yt = x[:1500], y[:1500]
     xv, yv = x[1500:], y[1500:]
-    params = dict(BASE, num_iterations=6)
+    params = dict(BASE, num_iterations=6, grow_policy="depthwise")
     ds = Dataset.from_arrays(xt, yt, max_bin=64)
     dsv = Dataset.from_arrays(xv, yv, max_bin=64, reference=ds)
 
@@ -375,7 +378,7 @@ def test_chunked_eval_matches_per_iter(synthetic_binary):
             break
 
     b2 = _make_booster(ds, params, valid=dsv)
-    assert b2.supports_chunking
+    assert b2.supports_chunking and b2.chunkable_for(True)
     b2.run_training(6, is_eval=True, chunk_size=3)
 
     assert len(b1.models) == len(b2.models)
@@ -399,7 +402,8 @@ def test_chunked_early_stopping_matches_per_iter(synthetic_binary):
     xv = x[1800:]
     yv = rng.randint(0, 2, size=len(xv)).astype(np.float32)  # pure noise
     params = dict(BASE, num_iterations=40, learning_rate=0.4,
-                  early_stopping_round=3, metric="binary_logloss")
+                  early_stopping_round=3, metric="binary_logloss",
+                  grow_policy="depthwise")
     ds = Dataset.from_arrays(xt, yt, max_bin=64)
     dsv = Dataset.from_arrays(xv, yv, max_bin=64, reference=ds)
 
@@ -411,7 +415,7 @@ def test_chunked_early_stopping_matches_per_iter(synthetic_binary):
             break
 
     b2 = _make_booster(ds, params, valid=dsv)
-    assert b2.supports_chunking
+    assert b2.supports_chunking and b2.chunkable_for(True)
     b2.run_training(40, is_eval=True, chunk_size=5)
 
     if not stopped1:
