@@ -46,6 +46,10 @@ def main() -> int:
                         choices=["depthwise", "leafwise"],
                         help="depthwise = TPU level-batched histograms "
                              "(headline); leafwise = reference-parity order")
+    parser.add_argument("--hist-chunk", type=int, default=0,
+                        help="histogram scan row-chunk (0 = policy default)")
+    parser.add_argument("--hist-dtype", default="float32",
+                        choices=["float32", "bfloat16"])
     args = parser.parse_args()
 
     import jax
@@ -66,6 +70,8 @@ def main() -> int:
         "min_sum_hessian_in_leaf": "10.0",
         "learning_rate": "0.1",
         "grow_policy": args.grow_policy,
+        "hist_chunk": str(args.hist_chunk),
+        "hist_dtype": args.hist_dtype,
         "num_iterations": str(2 * args.iters),
     }, require_data=False)
 

@@ -239,6 +239,12 @@ class TreeConfig:
     # the reference's strict best-first growth (serial_tree_learner.cpp:119-153);
     # "depthwise" grows level-batched for MXU throughput (grower_depthwise.py)
     grow_policy: str = "leafwise"
+    # TPU tuning knobs (no reference equivalent): row-chunk length of the
+    # histogram scan (0 = per-policy default) and the one-hot/value operand
+    # dtype of the histogram matmul ("float32" exact, "bfloat16" rounds
+    # grad/hess to 8 mantissa bits before the f32-accumulated matmul)
+    hist_chunk: int = 0
+    hist_dtype: str = "float32"
 
     def set(self, params: Dict[str, str]) -> None:
         self.min_data_in_leaf = _get_int(params, "min_data_in_leaf", self.min_data_in_leaf)
@@ -263,6 +269,13 @@ class TreeConfig:
             log.check(value in ("leafwise", "depthwise"),
                       "grow_policy must be leafwise or depthwise")
             self.grow_policy = value
+        self.hist_chunk = _get_int(params, "hist_chunk", self.hist_chunk)
+        log.check(self.hist_chunk >= 0, "hist_chunk should be >= 0")
+        if "hist_dtype" in params:
+            value = params["hist_dtype"].lower()
+            log.check(value in ("float32", "bfloat16"),
+                      "hist_dtype must be float32 or bfloat16")
+            self.hist_dtype = value
 
 
 @dataclasses.dataclass

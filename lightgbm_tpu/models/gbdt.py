@@ -425,6 +425,8 @@ class GBDT:
                 min_sum_hessian_in_leaf=(
                     self.tree_config.min_sum_hessian_in_leaf),
                 max_depth=self.tree_config.max_depth,
+                hist_chunk=self.tree_config.hist_chunk,
+                hist_dtype=self.tree_config.hist_dtype,
                 has_bag=has_bag, has_ff=has_ff,
                 train_metric_fns=tuple(s[2] for s in train_specs),
                 valid_metric_fns=tuple(tuple(s[2] for s in specs)
@@ -1003,12 +1005,13 @@ def _get_chunk_program(obj_key, grad_fn, num_class: int, lr: float,
                        grow_policy: str, *, num_leaves: int,
                        num_bins_max: int, min_data_in_leaf: int,
                        min_sum_hessian_in_leaf: float, max_depth: int,
+                       hist_chunk: int = 0, hist_dtype: str = "float32",
                        has_bag: bool, has_ff: bool,
                        train_metric_fns: tuple = (),
                        valid_metric_fns: tuple = ()):
     key = (obj_key, id(grad_fn), num_class, lr, grow_policy, num_leaves,
            num_bins_max, min_data_in_leaf, min_sum_hessian_in_leaf,
-           max_depth, has_bag, has_ff,
+           max_depth, hist_chunk, hist_dtype, has_bag, has_ff,
            tuple(id(f) for f in train_metric_fns),
            tuple(tuple(id(f) for f in fns) for fns in valid_metric_fns))
     prog = _CHUNK_PROGRAMS.get(key)
@@ -1018,7 +1021,8 @@ def _get_chunk_program(obj_key, grad_fn, num_class: int, lr: float,
     grower_kwargs = dict(
         num_leaves=num_leaves, num_bins_max=num_bins_max,
         min_data_in_leaf=min_data_in_leaf,
-        min_sum_hessian_in_leaf=min_sum_hessian_in_leaf, max_depth=max_depth)
+        min_sum_hessian_in_leaf=min_sum_hessian_in_leaf, max_depth=max_depth,
+        **_tuning_kwargs(grow_policy, hist_chunk, hist_dtype))
     if grow_policy == "depthwise":
         from .grower_depthwise import grow_tree_depthwise as grow
     else:
@@ -1046,6 +1050,16 @@ def _get_chunk_program(obj_key, grad_fn, num_class: int, lr: float,
     return prog
 
 
+def _tuning_kwargs(grow_policy: str, hist_chunk: int, hist_dtype: str) -> dict:
+    """Grower kwargs for the TPU tuning knobs (TreeConfig extensions)."""
+    kwargs = {}
+    if hist_chunk > 0:
+        kwargs["hist_chunk"] = hist_chunk
+    if hist_dtype == "bfloat16":
+        kwargs["compute_dtype"] = jnp.bfloat16
+    return kwargs
+
+
 def _serial_learner(gbdt: GBDT, bins, grad, hess, row_mask, feature_mask):
     """Default learner: single-device tree growth, leaf-wise (reference
     parity) or depth-wise (TPU throughput) per ``grow_policy``."""
@@ -1054,7 +1068,10 @@ def _serial_learner(gbdt: GBDT, bins, grad, hess, row_mask, feature_mask):
         num_bins_max=gbdt.num_bins_max,
         min_data_in_leaf=gbdt.tree_config.min_data_in_leaf,
         min_sum_hessian_in_leaf=gbdt.tree_config.min_sum_hessian_in_leaf,
-        max_depth=gbdt.tree_config.max_depth)
+        max_depth=gbdt.tree_config.max_depth,
+        **_tuning_kwargs(gbdt.tree_config.grow_policy,
+                         gbdt.tree_config.hist_chunk,
+                         gbdt.tree_config.hist_dtype))
     if gbdt.tree_config.grow_policy == "depthwise":
         from .grower_depthwise import grow_tree_depthwise_jit
         return grow_tree_depthwise_jit(bins, grad, hess, row_mask,

@@ -70,13 +70,14 @@ class _GrowState(NamedTuple):
     jax.jit,
     static_argnames=("num_leaves", "num_bins_max", "min_data_in_leaf",
                      "min_sum_hessian_in_leaf", "max_depth", "hist_backend",
-                     "hist_chunk"))
+                     "hist_chunk", "compute_dtype"))
 def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               row_mask: jax.Array, feature_mask: jax.Array,
               num_bins: jax.Array, *, num_leaves: int, num_bins_max: int,
               min_data_in_leaf: int, min_sum_hessian_in_leaf: float,
               max_depth: int = -1, hist_backend: str = "matmul",
-              hist_chunk: int = 16384) -> TreeArrays:
+              hist_chunk: int = 16384,
+              compute_dtype=jnp.float32) -> TreeArrays:
     """Grow one tree on a single device (TreeLearner::Train,
     serial_tree_learner.cpp:119-153).  See ``grow_tree_impl`` for the
     customization seam used by the parallel learners.
@@ -87,7 +88,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         min_data_in_leaf=min_data_in_leaf,
         min_sum_hessian_in_leaf=min_sum_hessian_in_leaf,
         max_depth=max_depth, hist_backend=hist_backend,
-        hist_chunk=hist_chunk)
+        hist_chunk=hist_chunk, compute_dtype=compute_dtype)
 
 
 def grow_tree_impl(bins: jax.Array, grad: jax.Array, hess: jax.Array,
@@ -95,7 +96,8 @@ def grow_tree_impl(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                    num_bins: jax.Array, *, num_leaves: int, num_bins_max: int,
                    min_data_in_leaf: int, min_sum_hessian_in_leaf: float,
                    max_depth: int = -1, hist_backend: str = "matmul",
-                   hist_chunk: int = 16384, hist_reduce=None,
+                   hist_chunk: int = 16384, compute_dtype=jnp.float32,
+                   hist_reduce=None,
                    split_finder=None, partition_bins=None,
                    stat_reduce=None) -> TreeArrays:
     """Core grower (not jitted; callers wrap it).
@@ -134,7 +136,8 @@ def grow_tree_impl(bins: jax.Array, grad: jax.Array, hess: jax.Array,
 
     def hist_of(mask):
         hist = build_histogram(bins, grad, hess, mask, B,
-                               backend=hist_backend, chunk=hist_chunk)
+                               backend=hist_backend, chunk=hist_chunk,
+                               compute_dtype=compute_dtype)
         if hist_reduce is not None:
             hist = hist_reduce(hist)
         return hist

@@ -60,7 +60,7 @@ def grow_tree_depthwise(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                         num_bins: jax.Array, *, num_leaves: int,
                         num_bins_max: int, min_data_in_leaf: int,
                         min_sum_hessian_in_leaf: float, max_depth: int = -1,
-                        hist_chunk: int = 262144, hist_reduce=None,
+                        hist_chunk: int = 65536, hist_reduce=None,
                         stat_reduce=None, split_finder=None,
                         partition_bins=None, compact_rows: bool = True,
                         compute_dtype=jnp.float32) -> TreeArrays:
@@ -194,16 +194,40 @@ def grow_tree_depthwise(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         n_nodes = n_nodes + n_chosen
 
         # ---- partition rows (DataPartition::Split as fused masked passes)
-        # per-slot split feature rows: [P, N] contiguous row gather
-        binsP = jnp.take(partition_bins, res.feature, axis=0).astype(i32)
-        lsel = slot_id[None, :] == jnp.arange(P, dtype=i32)[:, None]  # [P,N]
-        grP = binsP > res.threshold[:, None]                      # [P, N]
-        go_right = jnp.einsum("pn,pn->n", (lsel & chosen[:, None]).astype(f32),
-                              grP.astype(f32)) > 0.5
-        in_chosen = jnp.einsum("pn,p->n", lsel.astype(f32),
-                               chosen.astype(f32)) > 0.5
-        rl_row = jnp.einsum("pn,p->n", (lsel & chosen[:, None]).astype(f32),
-                            right_leaf.astype(f32)).astype(i32)
+        # All per-slot attributes a row needs (split feature, threshold,
+        # chosen flag, new right-leaf id, smaller-child side) ride ONE
+        # [P, N] one-hot matmul instead of one pass per attribute: the
+        # slot-select one-hot is the expensive object (O(P·N) comparisons),
+        # so it is generated once and contracted against a packed [P, K]
+        # table.
+        small_is_right = res.right_count < res.left_count        # ties → left
+        table = jnp.stack([res.feature.astype(f32),
+                           res.threshold.astype(f32),
+                           chosen.astype(f32),
+                           right_leaf.astype(f32),
+                           small_is_right.astype(f32)], axis=1)  # [P, 5]
+        lsel = (slot_id[None, :] ==
+                jnp.arange(P, dtype=i32)[:, None]).astype(f32)   # [P, N]
+        # HIGHEST precision: the table carries integer ids (feature,
+        # threshold, leaf); default TPU matmul precision truncates f32
+        # operands to bf16 and would corrupt ids > 256
+        attrs = jnp.einsum("pn,pk->kn", lsel, table,
+                           precision=jax.lax.Precision.HIGHEST,
+                           preferred_element_type=jnp.float32)   # [5, N]
+        feat_row = attrs[0].astype(i32)
+        thr_row = attrs[1].astype(i32)
+        in_chosen = attrs[2] > 0.5
+        rl_row = attrs[3].astype(i32)
+        small_right_row = attrs[4] > 0.5
+
+        # the row's bin on its slot's split feature: O(F·N) feature one-hot
+        # (F << P at deep levels; avoids materializing a [P, N] row gather)
+        fsel = (feat_row[None, :] ==
+                jnp.arange(partition_bins.shape[0], dtype=i32)[:, None])
+        row_bin = jnp.einsum("fn,fn->n", fsel.astype(f32),
+                             partition_bins.astype(f32),
+                             precision=jax.lax.Precision.HIGHEST).astype(i32)
+        go_right = row_bin > thr_row
         out_leaf = jnp.where(in_chosen & go_right, rl_row, out_leaf)
         slot_id = 2 * slot_id + jnp.where(in_chosen, go_right.astype(i32), 0)
 
@@ -224,29 +248,35 @@ def grow_tree_depthwise(bins: jax.Array, grad: jax.Array, hess: jax.Array,
 
         # ---- level histogram: build ONLY the smaller child of every chosen
         # parent in one batched pass, derive the sibling by subtraction
-        child_parity = slot_id % 2                              # 0=left
         par_of_row = slot_id // 2
-        # smaller-child choice from EXACT int32 row counts, not the f32
-        # histogram counts (whose rounding above ~2^24 rows per parent could
-        # mis-order near-equal children and overflow the N/2 compaction
-        # buffer below); int32 is exact and the tie rule (ties → left)
-        # keeps Σ_p min(nL, nR) <= N/2
-        onehot_p = par_of_row[None, :] == jnp.arange(P, dtype=i32)[:, None]
-        n_right = jnp.sum((onehot_p & (child_parity == 1)[None, :]
-                           & row_mask[None, :]).astype(i32), axis=1)
-        n_all = jnp.sum((onehot_p & row_mask[None, :]).astype(i32), axis=1)
-        # data-parallel: the choice must be REPLICATED across shards (each
-        # shard histograms the same child set before the psum), so reduce
-        # the counts globally like the root stats
-        if stat_reduce is not None:
-            counts = stat_reduce(jnp.stack([n_right, n_all]))
-            n_right, n_all = counts[0], counts[1]
-        small_is_right = n_right < (n_all - n_right)            # ties → left
-        small_sel = jnp.einsum(
-            "pn,pn->n",
-            (onehot_p & chosen[:, None]).astype(f32),
-            (child_parity[None, :] == small_is_right[:, None].astype(i32)
-             ).astype(f32)) > 0.5
+        # Smaller-child choice: SplitResult counts are integer-valued f32
+        # histogram sums, exact while rows < 2^24, so below that no recount
+        # pass is needed — ``small_is_right``/``small_right_row`` from the
+        # partition block above are already correct (and replicated under the
+        # data-parallel learner, whose counts come from psum'd histograms).
+        # Above 2^24 local rows, recount in int32 (f32 rounding could
+        # mis-order near-equal children and overflow the N/2 buffer).
+        if N < (1 << 24):
+            sel = in_chosen & (go_right == small_right_row) & row_mask
+        else:
+            child_parity = slot_id % 2                          # 0=left
+            onehot_p = par_of_row[None, :] == jnp.arange(P, dtype=i32)[:, None]
+            n_right = jnp.sum((onehot_p & (child_parity == 1)[None, :]
+                               & row_mask[None, :]).astype(i32), axis=1)
+            n_all = jnp.sum((onehot_p & row_mask[None, :]).astype(i32), axis=1)
+            # data-parallel: the choice must be REPLICATED across shards
+            # (each shard histograms the same child set before the psum), so
+            # reduce the counts globally like the root stats
+            if stat_reduce is not None:
+                counts = stat_reduce(jnp.stack([n_right, n_all]))
+                n_right, n_all = counts[0], counts[1]
+            small_is_right = n_right < (n_all - n_right)        # ties → left
+            small_sel = jnp.einsum(
+                "pn,pn->n",
+                (onehot_p & chosen[:, None]).astype(f32),
+                (child_parity[None, :] == small_is_right[:, None].astype(i32)
+                 ).astype(f32)) > 0.5
+            sel = small_sel & row_mask
         # Row compaction: every parent's smaller child holds at most half the
         # parent's rows, so Σ smaller-child rows <= N/2 ALWAYS — gather the
         # selected rows into a static [N/2] buffer and run the histogram
@@ -254,7 +284,6 @@ def grow_tree_depthwise(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         # included).  The reference gets the same effect from its per-leaf
         # index lists (data_partition.hpp); this is the masked-dense
         # equivalent.
-        sel = small_sel & row_mask
         # compaction pays for itself only when the batched matmul is wide:
         # at C <= 42 (vals operand one 128-lane tile) a full-N pass costs
         # about the same as the cumsum+scatter+gather of compaction plus a
@@ -308,4 +337,4 @@ grow_tree_depthwise_jit = jax.jit(
     grow_tree_depthwise,
     static_argnames=("num_leaves", "num_bins_max", "min_data_in_leaf",
                      "min_sum_hessian_in_leaf", "max_depth", "hist_chunk",
-                     "compact_rows"))
+                     "compact_rows", "compute_dtype"))

@@ -100,7 +100,7 @@ def _onehot_chunk(bins_chunk: jax.Array, vals_chunk: jax.Array, B: int,
 
 def histogram_leafbatch(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                         col_id: jax.Array, col_ok: jax.Array, num_cols: int,
-                        num_bins_max: int, chunk: int = 262144,
+                        num_bins_max: int, chunk: int = 65536,
                         compute_dtype=jnp.bfloat16) -> jax.Array:
     """Build histograms for MANY leaves in ONE matmul pass.
 

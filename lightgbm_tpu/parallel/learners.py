@@ -30,7 +30,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..models.grower import TreeArrays, grow_tree_impl
 from ..models.grower_depthwise import grow_tree_depthwise
-from ..models.gbdt import _effective_num_leaves
+from ..models.gbdt import _effective_num_leaves, _tuning_kwargs
 from ..ops.split import SplitResult, find_best_split
 from ..io.binning import BinMapper
 from ..utils import log
@@ -94,7 +94,10 @@ class _ParallelLearnerBase:
             num_bins_max=gbdt.num_bins_max,
             min_data_in_leaf=self.tree_config.min_data_in_leaf,
             min_sum_hessian_in_leaf=self.tree_config.min_sum_hessian_in_leaf,
-            max_depth=self.tree_config.max_depth)
+            max_depth=self.tree_config.max_depth,
+            **_tuning_kwargs(self.tree_config.grow_policy,
+                             self.tree_config.hist_chunk,
+                             self.tree_config.hist_dtype))
 
     @property
     def _depthwise(self) -> bool:
