@@ -4,11 +4,14 @@ Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
 Baseline: the reference LightGBM binary (compiled from /root/reference with
--O2, socket variant) measured on the SAME synthetic dataset and config
-(1M rows × 28 features, num_leaves=255, max_bin=255, binary objective) on
-the dev host CPU (single core): 0.433 s/iter → 2.31 iters/sec
-(BASELINE.md prescribes measuring the reference locally since the repo
-publishes no numbers).
+-O2, socket variant) measured on the SAME synthetic data generator and
+config (28 features, num_leaves=255, max_bin=255, binary objective) on the
+dev host CPU (single core), per BASELINE.md's prescription to measure
+locally since the repo publishes no numbers.  Anchors:
+  1M rows:  0.433 s/iter → 2.31 iters/sec
+  11M rows: 17.9 s/iter → 0.0559 iters/sec  (cache-bound: 41x slower for
+            11x the rows — the 308 MB bin matrix falls out of LLC)
+Other row counts interpolate the per-row cost log-linearly between anchors.
 
 Usage: python bench.py [--rows N] [--leaves L] [--iters K]
 """
@@ -16,12 +19,25 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import sys
 import time
 
 import numpy as np
 
-REFERENCE_CPU_ITERS_PER_SEC = 2.31  # see module docstring
+REFERENCE_CPU_ANCHORS = {1_000_000: 2.31, 11_000_000: 0.0559}
+
+
+def reference_iters_per_sec(rows: int) -> float:
+    """Reference-binary baseline at this scale: log-linear between anchors,
+    linear per-row cost beyond either end."""
+    (r0, v0), (r1, v1) = sorted(REFERENCE_CPU_ANCHORS.items())
+    if rows <= r0:
+        return v0 * (r0 / rows)
+    if rows >= r1:
+        return v1 * (r1 / rows)
+    t = (math.log(rows) - math.log(r0)) / (math.log(r1) - math.log(r0))
+    return math.exp(math.log(v0) * (1 - t) + math.log(v1) * t)
 
 
 def make_data(rows: int, features: int, seed: int = 42):
@@ -96,7 +112,8 @@ def main() -> int:
                   f"leaves{args.leaves}",
         "value": round(iters_per_sec, 4),
         "unit": "iters/sec",
-        "vs_baseline": round(iters_per_sec / REFERENCE_CPU_ITERS_PER_SEC, 4),
+        "vs_baseline": round(
+            iters_per_sec / reference_iters_per_sec(args.rows), 4),
     }))
     return 0
 

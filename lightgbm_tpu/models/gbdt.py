@@ -1022,7 +1022,7 @@ def _get_chunk_program(obj_key, grad_fn, num_class: int, lr: float,
         num_leaves=num_leaves, num_bins_max=num_bins_max,
         min_data_in_leaf=min_data_in_leaf,
         min_sum_hessian_in_leaf=min_sum_hessian_in_leaf, max_depth=max_depth,
-        **_tuning_kwargs(grow_policy, hist_chunk, hist_dtype))
+        **_tuning_kwargs(hist_chunk, hist_dtype))
     if grow_policy == "depthwise":
         from .grower_depthwise import grow_tree_depthwise as grow
     else:
@@ -1050,7 +1050,7 @@ def _get_chunk_program(obj_key, grad_fn, num_class: int, lr: float,
     return prog
 
 
-def _tuning_kwargs(grow_policy: str, hist_chunk: int, hist_dtype: str) -> dict:
+def _tuning_kwargs(hist_chunk: int, hist_dtype: str) -> dict:
     """Grower kwargs for the TPU tuning knobs (TreeConfig extensions)."""
     kwargs = {}
     if hist_chunk > 0:
@@ -1069,8 +1069,7 @@ def _serial_learner(gbdt: GBDT, bins, grad, hess, row_mask, feature_mask):
         min_data_in_leaf=gbdt.tree_config.min_data_in_leaf,
         min_sum_hessian_in_leaf=gbdt.tree_config.min_sum_hessian_in_leaf,
         max_depth=gbdt.tree_config.max_depth,
-        **_tuning_kwargs(gbdt.tree_config.grow_policy,
-                         gbdt.tree_config.hist_chunk,
+        **_tuning_kwargs(gbdt.tree_config.hist_chunk,
                          gbdt.tree_config.hist_dtype))
     if gbdt.tree_config.grow_policy == "depthwise":
         from .grower_depthwise import grow_tree_depthwise_jit

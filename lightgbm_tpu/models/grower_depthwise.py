@@ -62,7 +62,7 @@ def grow_tree_depthwise(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                         min_sum_hessian_in_leaf: float, max_depth: int = -1,
                         hist_chunk: int = 65536, hist_reduce=None,
                         stat_reduce=None, split_finder=None,
-                        partition_bins=None, compact_rows: bool = True,
+                        partition_bins=None,
                         compute_dtype=jnp.float32) -> TreeArrays:
     """Grow one depth-wise tree.  Output contract == grow_tree_impl's
     TreeArrays (models/grower.py), so boosting/serialization/prediction are
@@ -88,10 +88,6 @@ def grow_tree_depthwise(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     maskf = row_mask.astype(f32)
     mind = float(min_data_in_leaf)
     minh = float(min_sum_hessian_in_leaf)
-    # a stat_reduce hook means rows are sharded (data-parallel): the global
-    # smaller-child choice then voids the local N/2 compaction bound, so
-    # compaction is structurally incompatible — force it off
-    compact_rows = compact_rows and stat_reduce is None
 
     def batch_hist_rows(b, g, h, col_id, col_ok, C):
         out = histogram_leafbatch(b, g, h, col_id, col_ok, C, B,
@@ -220,13 +216,19 @@ def grow_tree_depthwise(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         rl_row = attrs[3].astype(i32)
         small_right_row = attrs[4] > 0.5
 
-        # the row's bin on its slot's split feature: O(F·N) feature one-hot
-        # (F << P at deep levels; avoids materializing a [P, N] row gather)
-        fsel = (feat_row[None, :] ==
-                jnp.arange(partition_bins.shape[0], dtype=i32)[:, None])
-        row_bin = jnp.einsum("fn,fn->n", fsel.astype(f32),
-                             partition_bins.astype(f32),
-                             precision=jax.lax.Precision.HIGHEST).astype(i32)
+        # the row's bin on its slot's split feature: an O(F·N) feature
+        # one-hot avoids materializing the old [P, N] row gather, but its
+        # cost grows with the dataset width — for wide datasets a direct
+        # per-row gather is cheaper than F·N comparisons
+        Fg = partition_bins.shape[0]
+        if Fg <= 128:
+            fsel = (feat_row[None, :] == jnp.arange(Fg, dtype=i32)[:, None])
+            row_bin = jnp.einsum(
+                "fn,fn->n", fsel.astype(f32), partition_bins.astype(f32),
+                precision=jax.lax.Precision.HIGHEST).astype(i32)
+        else:
+            row_bin = jnp.take_along_axis(
+                partition_bins, feat_row[None, :], axis=0)[0].astype(i32)
         go_right = row_bin > thr_row
         out_leaf = jnp.where(in_chosen & go_right, rl_row, out_leaf)
         slot_id = 2 * slot_id + jnp.where(in_chosen, go_right.astype(i32), 0)
@@ -255,7 +257,7 @@ def grow_tree_depthwise(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         # partition block above are already correct (and replicated under the
         # data-parallel learner, whose counts come from psum'd histograms).
         # Above 2^24 local rows, recount in int32 (f32 rounding could
-        # mis-order near-equal children and overflow the N/2 buffer).
+        # mis-order near-equal children).
         if N < (1 << 24):
             sel = in_chosen & (go_right == small_right_row) & row_mask
         else:
@@ -277,38 +279,13 @@ def grow_tree_depthwise(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                 (child_parity[None, :] == small_is_right[:, None].astype(i32)
                  ).astype(f32)) > 0.5
             sel = small_sel & row_mask
-        # Row compaction: every parent's smaller child holds at most half the
-        # parent's rows, so Σ smaller-child rows <= N/2 ALWAYS — gather the
-        # selected rows into a static [N/2] buffer and run the histogram
-        # matmul on half the data (measured 2x on the level pass, gathers
-        # included).  The reference gets the same effect from its per-leaf
-        # index lists (data_partition.hpp); this is the masked-dense
-        # equivalent.
-        # compaction pays for itself only when the batched matmul is wide:
-        # at C <= 42 (vals operand one 128-lane tile) a full-N pass costs
-        # about the same as the cumsum+scatter+gather of compaction plus a
-        # half-N pass, so skip the index plumbing for shallow levels
-        if compact_rows and P > 42:
-            # The N/2 capacity proof needs smaller-child identity and the
-            # compacted row population to use the SAME counts; under the
-            # data-parallel learner 'smaller' comes from GLOBAL (psum'd)
-            # counts while rows here are the local shard, so a skewed shard
-            # could overflow — that learner passes compact_rows=False.
-            Nh = (N + 1) // 2
-            pos = jnp.cumsum(sel.astype(i32)) - 1
-            tgt = jnp.where(sel, pos, BIG)
-            gidx = jnp.zeros((Nh,), i32).at[tgt].set(
-                jnp.arange(N, dtype=i32), mode="drop")
-            hvalid = jnp.arange(Nh, dtype=i32) <= pos[-1]
-            # one fused gather for grad/hess/slot (slot rides as bitcast f32)
-            packed = jnp.stack([grad, hess, jax.lax.bitcast_convert_type(
-                par_of_row, jnp.float32)])
-            pk = jnp.take(packed, gidx, axis=1)                   # [3, Nh]
-            par_h = jax.lax.bitcast_convert_type(pk[2], i32)
-            hist_small = batch_hist_rows(
-                jnp.take(bins, gidx, axis=1), pk[0], pk[1], par_h, hvalid, P)
-        else:
-            hist_small = batch_hist(par_of_row, sel, P)
+        # The masked full-N pass is the fastest smaller-child schedule
+        # measured on v5e (1M and 11M rows): gathering the selected rows
+        # into a compact N/2 buffer first (the masked-dense analog of the
+        # reference's per-leaf index lists, data_partition.hpp) costs more
+        # in cumsum/scatter/gather plumbing than the halved histogram pass
+        # saves — see git history for the removed compaction path.
+        hist_small = batch_hist(par_of_row, sel, P)
         hist_large = hists - hist_small
         hsmall_slot = interleave(jnp.where(small_is_right[:, None, None, None],
                                            hist_large, hist_small),
@@ -337,4 +314,4 @@ grow_tree_depthwise_jit = jax.jit(
     grow_tree_depthwise,
     static_argnames=("num_leaves", "num_bins_max", "min_data_in_leaf",
                      "min_sum_hessian_in_leaf", "max_depth", "hist_chunk",
-                     "compact_rows", "compute_dtype"))
+                     "compute_dtype"))

@@ -59,8 +59,7 @@ def histogram_matmul(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                       maskf], axis=1)  # [N, 3]
 
     if N <= chunk:
-        hist = _onehot_chunk(bins.astype(jnp.int32), vals, B, compute_dtype)
-        return hist.astype(jnp.float32)
+        return _onehot_chunk(bins.astype(jnp.int32), vals, B, compute_dtype)
 
     pad = (-N) % chunk
     if pad:
@@ -76,14 +75,18 @@ def histogram_matmul(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                                       compute_dtype)
         return carry, None
 
-    init = jnp.zeros((F, B, 3), dtype=compute_dtype)
+    # the cross-chunk accumulator stays f32 regardless of compute_dtype:
+    # only the matmul OPERANDS are lowered (counts in the thousands are not
+    # representable in bf16)
+    init = jnp.zeros((F, B, 3), dtype=jnp.float32)
     hist, _ = jax.lax.scan(body, init, (bins_c, vals_c))
-    return hist.astype(jnp.float32)
+    return hist
 
 
 def _onehot_chunk(bins_chunk: jax.Array, vals_chunk: jax.Array, B: int,
                   compute_dtype) -> jax.Array:
-    """One chunk: [F, C] bins + [C, 3] vals -> [F, B, 3] partial histogram.
+    """One chunk: [F, C] bins + [C, 3] vals -> [F, B, 3] f32 partial
+    histogram (operands in compute_dtype, accumulation always f32).
 
     The einsum contracts over rows; output layout [F*B, 3] keeps the large
     dimension on the MXU lane axis.
@@ -93,9 +96,9 @@ def _onehot_chunk(bins_chunk: jax.Array, vals_chunk: jax.Array, B: int,
     onehot = (bins_chunk[:, :, None] == iota).astype(compute_dtype)  # [F, C, B]
     # [3, C] @ [C, F*B] -> [3, F*B]
     flat = onehot.transpose(1, 0, 2).reshape(C, F * B)
-    out = jnp.dot(vals_chunk.T, flat,
+    out = jnp.dot(vals_chunk.astype(compute_dtype).T, flat,
                   preferred_element_type=jnp.float32)  # [3, F*B]
-    return out.reshape(3, F, B).transpose(1, 2, 0).astype(compute_dtype)
+    return out.reshape(3, F, B).transpose(1, 2, 0)
 
 
 def histogram_leafbatch(bins: jax.Array, grad: jax.Array, hess: jax.Array,
@@ -165,7 +168,10 @@ def histogram_leafbatch(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         return carry + out, None
 
     init = jnp.zeros((F, B, C * 3), jnp.float32)
-    hist, _ = jax.lax.scan(body, init, (bins_c, vals_c, cid_c))
+    # unroll: several chunks per loop iteration lets the scheduler overlap
+    # the next chunk's HBM loads with the current chunk's compute
+    hist, _ = jax.lax.scan(body, init, (bins_c, vals_c, cid_c),
+                           unroll=min(4, n_chunks))
     hist = hist.reshape(F, B, C, 3).transpose(2, 0, 1, 3)        # [C, F, B, 3]
     return hist[:num_cols]
 

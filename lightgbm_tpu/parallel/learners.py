@@ -95,8 +95,7 @@ class _ParallelLearnerBase:
             min_data_in_leaf=self.tree_config.min_data_in_leaf,
             min_sum_hessian_in_leaf=self.tree_config.min_sum_hessian_in_leaf,
             max_depth=self.tree_config.max_depth,
-            **_tuning_kwargs(self.tree_config.grow_policy,
-                             self.tree_config.hist_chunk,
+            **_tuning_kwargs(self.tree_config.hist_chunk,
                              self.tree_config.hist_dtype))
 
     @property
@@ -136,8 +135,6 @@ class DataParallelLearner(_ParallelLearnerBase):
             return prog, num_shards
 
         grow = grow_tree_depthwise if depthwise else grow_tree_impl
-        if depthwise:
-            kwargs = dict(kwargs, compact_rows=False)
         lrf = jnp.float32(lr)
 
         def shard_chunk(score, bins, num_bins, valid_rows, row_masks,
@@ -193,11 +190,6 @@ class DataParallelLearner(_ParallelLearnerBase):
         if self._jitted is None:
             kwargs = self._grow_kwargs(gbdt)
             grow = grow_tree_depthwise if self._depthwise else grow_tree_impl
-
-            if self._depthwise:
-                # global smaller-child choice vs local shard rows breaks the
-                # N/2 compaction capacity proof (see grow_tree_depthwise)
-                kwargs = dict(kwargs, compact_rows=False)
 
             def shard_fn(bins_s, grad_s, hess_s, mask_s, fmask, nbins):
                 return grow(
