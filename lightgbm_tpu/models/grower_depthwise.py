@@ -251,34 +251,14 @@ def grow_tree_depthwise(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         # ---- level histogram: build ONLY the smaller child of every chosen
         # parent in one batched pass, derive the sibling by subtraction
         par_of_row = slot_id // 2
-        # Smaller-child choice: SplitResult counts are integer-valued f32
-        # histogram sums, exact while rows < 2^24, so below that no recount
-        # pass is needed — ``small_is_right``/``small_right_row`` from the
-        # partition block above are already correct (and replicated under the
-        # data-parallel learner, whose counts come from psum'd histograms).
-        # Above 2^24 local rows, recount in int32 (f32 rounding could
-        # mis-order near-equal children).
-        if N < (1 << 24):
-            sel = in_chosen & (go_right == small_right_row) & row_mask
-        else:
-            child_parity = slot_id % 2                          # 0=left
-            onehot_p = par_of_row[None, :] == jnp.arange(P, dtype=i32)[:, None]
-            n_right = jnp.sum((onehot_p & (child_parity == 1)[None, :]
-                               & row_mask[None, :]).astype(i32), axis=1)
-            n_all = jnp.sum((onehot_p & row_mask[None, :]).astype(i32), axis=1)
-            # data-parallel: the choice must be REPLICATED across shards
-            # (each shard histograms the same child set before the psum), so
-            # reduce the counts globally like the root stats
-            if stat_reduce is not None:
-                counts = stat_reduce(jnp.stack([n_right, n_all]))
-                n_right, n_all = counts[0], counts[1]
-            small_is_right = n_right < (n_all - n_right)        # ties → left
-            small_sel = jnp.einsum(
-                "pn,pn->n",
-                (onehot_p & chosen[:, None]).astype(f32),
-                (child_parity[None, :] == small_is_right[:, None].astype(i32)
-                 ).astype(f32)) > 0.5
-            sel = small_sel & row_mask
+        # Smaller-child choice from the SplitResult counts (integer-valued
+        # f32 histogram sums; replicated under the data-parallel learner,
+        # whose counts come from psum'd histograms).  Above 2^24 rows per
+        # node the f32 rounding could mis-order near-equal children — that
+        # only means the pass histograms the slightly larger child (the
+        # sibling is still exact via subtraction), a perf non-event, so no
+        # recount is needed at any scale.
+        sel = in_chosen & (go_right == small_right_row) & row_mask
         # The masked full-N pass is the fastest smaller-child schedule
         # measured on v5e (1M and 11M rows): gathering the selected rows
         # into a compact N/2 buffer first (the masked-dense analog of the

@@ -128,6 +128,22 @@ def histogram_leafbatch(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     """
     F, N = bins.shape
     B = num_bins_max
+    # cap the pass at ONE 128-lane tile of the value operand (42 histogram
+    # columns × 3): a C=64 pass costs ~2x what two 42-wide passes do on v5e
+    # (the conv-lowered kernel's cost grows superlinearly past a tile), so
+    # wide levels loop single-tile groups, balanced so the last group is
+    # never a nearly-empty full-row pass (128 -> 4x32, not 42/42/42/2)
+    if num_cols > 42:
+        n_groups = -(-num_cols // 42)
+        width = -(-num_cols // n_groups)
+        parts = []
+        for base in range(0, num_cols, width):
+            k = min(width, num_cols - base)
+            ok = col_ok & (col_id >= base) & (col_id < base + k)
+            parts.append(histogram_leafbatch(
+                bins, grad, hess, col_id - base, ok, k, num_bins_max,
+                chunk=chunk, compute_dtype=compute_dtype))
+        return jnp.concatenate(parts, axis=0)
     # keep the value operand >= ~126 columns so the MXU tile is full even
     # for small levels (cols are zero-padded; wasted cols are free compared
     # to a starved tile)

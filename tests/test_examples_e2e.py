@@ -107,3 +107,22 @@ def test_binary_save_binary_cache(tmp_path, monkeypatch):
     app2.run()
     np.testing.assert_allclose(np.asarray(app2.boosting.score[0]), score1,
                                rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("learner", ["feature", "data"])
+def test_parallel_learning(tmp_path, monkeypatch, learner):
+    """examples/parallel_learning runs with its config unchanged: the
+    machine-list/port bootstrap keys are accepted and the mesh replaces the
+    socket cluster (README steps 1-3; tree_learner=feature in train.conf,
+    data-parallel via the documented override)."""
+    app = _run_example(
+        tmp_path, "parallel_learning",
+        ["binary.train", "binary.test", "mlist.txt", "train.conf",
+         "predict.conf"],
+        FAST + [f"tree_learner={learner}"], monkeypatch)
+    assert len(app.boosting.models) == 5
+    auc = app.boosting.valid_metrics[0][1].eval(
+        np.asarray(app.boosting.valid_datasets[0]["score"][0]))[0]
+    assert auc > 0.7
+    preds = _predict_example(tmp_path, monkeypatch)
+    assert ((preds >= 0) & (preds <= 1)).all()

@@ -51,3 +51,28 @@ def test_histogram_chunked_padding():
     # counts must be exact integers
     np.testing.assert_array_equal(hist[:, :, 2].sum(axis=1),
                                   np.full(F, n, dtype=np.float32))
+
+
+@pytest.mark.parametrize("num_cols", [64, 128, 100])
+def test_leafbatch_wide_tiling_matches_oracle(num_cols):
+    """num_cols > 42 tiles into balanced single-MXU-tile groups; the
+    col_id re-basing and window masks must reproduce the untiled result
+    (this is the num_leaves=255 deep-level production path)."""
+    from lightgbm_tpu.ops.histogram import histogram_leafbatch
+    rng = np.random.RandomState(3)
+    F, B, n = 4, 16, 4096
+    bins = rng.randint(0, B, size=(F, n)).astype(np.uint8)
+    grad = rng.randn(n).astype(np.float32)
+    hess = rng.rand(n).astype(np.float32)
+    col_id = rng.randint(0, num_cols, size=n).astype(np.int32)
+    col_ok = rng.rand(n) > 0.4
+    hist = np.asarray(histogram_leafbatch(
+        jnp.asarray(bins), jnp.asarray(grad), jnp.asarray(hess),
+        jnp.asarray(col_id), jnp.asarray(col_ok), num_cols, B,
+        compute_dtype=jnp.float32))
+    assert hist.shape == (num_cols, F, B, 3)
+    for c in range(num_cols):
+        m = col_ok & (col_id == c)
+        np.testing.assert_allclose(
+            hist[c], _numpy_hist(bins, grad, hess, m, B),
+            rtol=1e-5, atol=1e-5)
