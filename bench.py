@@ -55,9 +55,12 @@ def main() -> int:
     parser.add_argument("--features", type=int, default=28)
     parser.add_argument("--leaves", type=int, default=255)
     parser.add_argument("--max-bin", type=int, default=255)
-    parser.add_argument("--iters", type=int, default=16,
+    parser.add_argument("--iters", type=int, default=64,
                         help="iterations per chunk; one chunk warms up "
-                             "(compiles) and one chunk is timed")
+                             "(compiles) and one chunk is timed.  Bigger "
+                             "chunks amortize the per-dispatch host "
+                             "round-trip (16: 7.2, 32: 7.7, 64: 7.9 "
+                             "iters/sec at the 1M default)")
     parser.add_argument("--grow-policy", default="depthwise",
                         choices=["depthwise", "leafwise"],
                         help="depthwise = TPU level-batched histograms "
@@ -74,6 +77,11 @@ def main() -> int:
     from lightgbm_tpu.io.dataset import Dataset
     from lightgbm_tpu.models.gbdt import GBDT
     from lightgbm_tpu.objectives import create_objective
+    from lightgbm_tpu.utils import log
+
+    # stdout carries exactly ONE JSON line; all library logs go to stderr
+    log.set_stream(sys.stderr)
+    log.set_level(log.WARNING)
 
     x, y = make_data(args.rows, args.features)
     ds = Dataset.from_arrays(x, y, max_bin=args.max_bin)

@@ -19,6 +19,7 @@ INFO = 2
 DEBUG = 3
 
 _level = INFO
+_stream = None  # None → sys.stdout (reference parity, log.h:35-89)
 
 
 class LightGBMError(RuntimeError):
@@ -34,9 +35,17 @@ def get_level() -> int:
     return _level
 
 
+def set_stream(stream) -> None:
+    """Redirect log output (None restores stdout).  Harnesses that reserve
+    stdout for machine-readable output route logs to stderr."""
+    global _stream
+    _stream = stream
+
+
 def _write(tag: str, msg: str) -> None:
-    sys.stdout.write(f"[LightGBM] [{tag}] {msg}\n")
-    sys.stdout.flush()
+    out = _stream if _stream is not None else sys.stdout
+    out.write(f"[LightGBM] [{tag}] {msg}\n")
+    out.flush()
 
 
 def debug(msg: str, *args) -> None:
