@@ -1,0 +1,134 @@
+"""Accuracy parity at bench scale: our depthwise TPU training vs the
+compiled reference binary, 100 iterations on the same Higgs-style 1M-row
+synthetic data, held-out AUC compared.
+
+The depthwise grower's split ORDER differs from the reference (level order
+vs global best-first), so trees are not expected to be identical — the
+claim under test is that the MODEL QUALITY matches at equal iteration
+count and config (BASELINE.json north star: "AUC parity").
+
+Usage: python scripts/auc_parity.py [--rows N] [--iters K]
+Writes nothing; prints a small report.  Needs the compiled reference at
+/tmp/lightgbm_reference_build/lightgbm (tests/test_reference_differential.py
+builds it).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from bench import make_data
+
+REF_BIN = "/tmp/lightgbm_reference_build/lightgbm"
+
+
+def auc_manual(labels: np.ndarray, scores: np.ndarray) -> float:
+    """Rank-based AUC with tie handling (matches metric definitions)."""
+    pos = scores[labels > 0.5]
+    neg = scores[labels <= 0.5]
+    allv = np.concatenate([pos, neg])
+    order = np.argsort(allv, kind="mergesort")
+    ranks = np.empty(len(allv))
+    ranks[order] = np.arange(1, len(allv) + 1)
+    sv = allv[order]
+    # average ranks over ties
+    uniq, inv, counts = np.unique(sv, return_inverse=True, return_counts=True)
+    start = np.zeros(len(uniq))
+    start[1:] = np.cumsum(counts)[:-1]
+    avg = start + (counts + 1) / 2.0
+    ranks = avg[inv[np.argsort(order)]]
+    r_pos = ranks[: len(pos)].sum()
+    n_pos, n_neg = len(pos), len(neg)
+    return (r_pos - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=1_000_000)
+    ap.add_argument("--test-rows", type=int, default=200_000)
+    ap.add_argument("--iters", type=int, default=100)
+    ap.add_argument("--grow-policy", default="depthwise",
+                    choices=["depthwise", "leafwise"])
+    args = ap.parse_args()
+
+    x, y = make_data(args.rows + args.test_rows, 28)
+    xtr, ytr = x[: args.rows], y[: args.rows]
+    xte, yte = x[args.rows:], y[args.rows:]
+
+    conf_common = dict(objective="binary", num_trees=args.iters,
+                       learning_rate="0.1", num_leaves="255", max_bin="255",
+                       min_data_in_leaf="100",
+                       min_sum_hessian_in_leaf="10.0")
+
+    # ---- ours (depthwise, fused chunks)
+    import jax
+    from lightgbm_tpu.config import OverallConfig
+    from lightgbm_tpu.io.dataset import Dataset
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.objectives import create_objective
+
+    ds = Dataset.from_arrays(xtr, ytr, max_bin=255)
+    cfg = OverallConfig()
+    cfg.set({**{k: str(v) for k, v in conf_common.items()},
+             "num_iterations": str(args.iters),
+             "grow_policy": args.grow_policy}, require_data=False)
+    booster = GBDT()
+    booster.init(cfg.boosting_config, ds,
+                 create_objective(cfg.objective_type, cfg.objective_config))
+    t0 = time.time()
+    done = 0
+    while done < args.iters:
+        k = min(64, args.iters - done)
+        booster.train_chunk(k)
+        done += k
+    jax.block_until_ready(booster.score)
+    t_ours = time.time() - t0
+    ours_scores = booster.predict_raw(xte)
+    ours_auc = auc_manual(yte, ours_scores)
+    print(f"ours[{args.grow_policy}]: {args.iters} iters in {t_ours:.1f}s "
+          f"wall incl. jit compile (bench.py reports steady-state "
+          f"throughput), test AUC {ours_auc:.6f}", flush=True)
+
+    # ---- reference binary
+    if not os.path.exists(REF_BIN):
+        print("reference binary not built; skipping reference side")
+        return 0
+    import pandas as pd
+    tr_csv, te_csv = "/tmp/parity_train.csv", "/tmp/parity_test.csv"
+    pd.DataFrame(np.column_stack([ytr, xtr])).to_csv(
+        tr_csv, index=False, header=False, float_format="%.7g")
+    pd.DataFrame(np.column_stack([yte, xte])).to_csv(
+        te_csv, index=False, header=False, float_format="%.7g")
+    conf = "\n".join(["task=train", f"data={tr_csv}",
+                      f"num_trees={args.iters}"] +
+                     [f"{k}={v}" for k, v in conf_common.items()
+                      if k != "num_trees"] +
+                     ["metric_freq=1000", "is_training_metric=false",
+                      "output_model=/tmp/parity_model.txt"])
+    open("/tmp/parity_train.conf", "w").write(conf + "\n")
+    t0 = time.time()
+    subprocess.run([REF_BIN, "config=/tmp/parity_train.conf"], check=True,
+                   capture_output=True, text=True)
+    t_ref = time.time() - t0
+    open("/tmp/parity_pred.conf", "w").write(
+        f"task=predict\ndata={te_csv}\ninput_model=/tmp/parity_model.txt\n"
+        "output_result=/tmp/parity_pred.txt\nis_sigmoid=false\n")
+    subprocess.run([REF_BIN, "config=/tmp/parity_pred.conf"], check=True,
+                   capture_output=True, text=True)
+    ref_scores = np.loadtxt("/tmp/parity_pred.txt")
+    ref_auc = auc_manual(yte, ref_scores)
+    print(f"reference: {args.iters} iters in {t_ref:.1f}s "
+          f"({args.iters / t_ref:.2f} iters/s), test AUC {ref_auc:.6f}",
+          flush=True)
+    print(f"AUC delta (ours - reference): {ours_auc - ref_auc:+.6f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
