@@ -30,7 +30,13 @@ REFERENCE_CPU_ANCHORS = {1_000_000: 2.31, 11_000_000: 0.0559}
 
 def reference_iters_per_sec(rows: int) -> float:
     """Reference-binary baseline at this scale: log-linear between anchors,
-    linear per-row cost beyond either end."""
+    linear per-row cost beyond either end.
+
+    Below the 1M anchor this extrapolates the 1M per-row cost linearly, but
+    the reference is FASTER per row at cache-resident scales (the 11M anchor
+    is 41x slower for 11x the rows precisely because 1M still partly fits in
+    LLC) — so sub-1M ``vs_baseline`` is an upper-bound estimate; the JSON
+    carries a ``vs_baseline_bound`` marker there."""
     (r0, v0), (r1, v1) = sorted(REFERENCE_CPU_ANCHORS.items())
     if rows <= r0:
         return v0 * (r0 / rows)
@@ -51,7 +57,9 @@ def make_data(rows: int, features: int, seed: int = 42):
 
 def main() -> int:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--rows", type=int, default=1_000_000)
+    # 11M rows is the headline scale (BASELINE.md north star: Higgs-11M,
+    # num_leaves=255); pass --rows 1000000 for the quick tuning scale
+    parser.add_argument("--rows", type=int, default=11_000_000)
     parser.add_argument("--features", type=int, default=28)
     parser.add_argument("--leaves", type=int, default=255)
     parser.add_argument("--max-bin", type=int, default=255)
@@ -115,14 +123,19 @@ def main() -> int:
     elapsed = time.time() - start
 
     iters_per_sec = args.iters / elapsed
-    print(json.dumps({
+    out = {
         "metric": f"boosting_iters_per_sec_higgs{args.rows // 1000}k_"
                   f"leaves{args.leaves}",
         "value": round(iters_per_sec, 4),
         "unit": "iters/sec",
         "vs_baseline": round(
             iters_per_sec / reference_iters_per_sec(args.rows), 4),
-    }))
+    }
+    if args.rows < min(REFERENCE_CPU_ANCHORS):
+        # sub-anchor scales extrapolate a cache-unfriendly per-row cost the
+        # reference doesn't actually pay when the data fits in LLC
+        out["vs_baseline_bound"] = "upper"
+    print(json.dumps(out))
     return 0
 
 

@@ -122,7 +122,8 @@ class DataParallelLearner(_ParallelLearnerBase):
         Returns (program, num_shards).  The caller pads rows to a multiple
         of num_shards and passes ``valid_rows`` (False on padding) so padded
         rows never enter histograms or root stats."""
-        mesh = get_mesh(self.config.network_config.num_machines, DATA_AXIS)
+        mesh = get_mesh(self.config.network_config.num_machines, DATA_AXIS,
+                        getattr(self.config, 'device_type', ''))
         num_shards = mesh.shape[DATA_AXIS]
         num_class = gbdt.num_class
         lr = float(gbdt.gbdt_config.learning_rate)
@@ -177,7 +178,8 @@ class DataParallelLearner(_ParallelLearnerBase):
         return prog, num_shards
 
     def __call__(self, gbdt, bins, grad, hess, row_mask, feature_mask):
-        mesh = get_mesh(self.config.network_config.num_machines, DATA_AXIS)
+        mesh = get_mesh(self.config.network_config.num_machines, DATA_AXIS,
+                        getattr(self.config, 'device_type', ''))
         num_shards = mesh.shape[DATA_AXIS]
         F, N = bins.shape
         pad = (-N) % num_shards
@@ -219,7 +221,8 @@ class FeatureParallelLearner(_ParallelLearnerBase):
     result is invariant to ownership, only load balance differs."""
 
     def __call__(self, gbdt, bins, grad, hess, row_mask, feature_mask):
-        mesh = get_mesh(self.config.network_config.num_machines, FEATURE_AXIS)
+        mesh = get_mesh(self.config.network_config.num_machines, FEATURE_AXIS,
+                        getattr(self.config, 'device_type', ''))
         num_shards = mesh.shape[FEATURE_AXIS]
         F, N = bins.shape
         Fs = -(-F // num_shards)  # owned features per shard

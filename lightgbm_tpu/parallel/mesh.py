@@ -21,8 +21,6 @@ from ..utils import log
 DATA_AXIS = "data"
 FEATURE_AXIS = "feature"
 
-_mesh: Optional[Mesh] = None
-
 
 def init_distributed(config=None) -> None:
     """Multi-host bootstrap (linkers_socket.cpp equivalent).
@@ -39,10 +37,14 @@ def init_distributed(config=None) -> None:
 
 
 def get_mesh(num_machines: Optional[int] = None,
-             axis_name: str = DATA_AXIS) -> Mesh:
-    """1-D mesh over the first ``num_machines`` devices."""
-    global _mesh
-    devices = jax.devices()
+             axis_name: str = DATA_AXIS,
+             device_type: str = "") -> Mesh:
+    """1-D mesh over the first ``num_machines`` devices.
+
+    ``device_type`` (config.py device_type: "cpu"/"tpu"/"gpu") selects the
+    backend to draw mesh slots from in mixed-backend processes; empty means
+    the default platform."""
+    devices = jax.devices(device_type) if device_type else jax.devices()
     if num_machines is None or num_machines <= 0:
         num_machines = len(devices)
     if num_machines > len(devices):

@@ -1,0 +1,99 @@
+"""Per-phase cost attribution for the depthwise training iteration.
+
+Methodology (see memory notes / PROFILE.md): bench-style A/B at full scale
+is the only low-noise ground truth on the tunneled TPU.  This script times
+the SAME fused k-iteration chunk program in variants that stub one phase
+each, so the phase cost falls out as a difference of end-to-end rates:
+
+  full        : unmodified train_chunk
+  nohist      : histogram_leafbatch replaced by a cheap data-dependent
+                broadcast (keeps the program structure and all downstream
+                consumers; removes the MXU one-hot passes)
+
+Usage: python scripts/profile_phases.py --rows 11000000 --iters 8
+Prints one JSON line per variant.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_variant(variant: str, args) -> float:
+    import jax
+    import jax.numpy as jnp
+    import lightgbm_tpu  # noqa: F401
+    from lightgbm_tpu.config import OverallConfig
+    from lightgbm_tpu.io.dataset import Dataset
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.objectives import create_objective
+    from lightgbm_tpu.utils import log
+    from lightgbm_tpu.models import grower_depthwise
+    from lightgbm_tpu.ops import histogram
+
+    log.set_stream(sys.stderr)
+    log.set_level(log.WARNING)
+
+    if variant == "nohist":
+        real = histogram.histogram_leafbatch
+
+        def stub(bins, grad, hess, col_id, col_ok, num_cols, num_bins_max,
+                 chunk=65536, compute_dtype=jnp.bfloat16):
+            F = bins.shape[0]
+            # data-dependent (not constant-foldable), trivially cheap
+            seed = (jnp.sum(grad[:8]) + col_id[0].astype(jnp.float32))
+            return jnp.full((num_cols, F, num_bins_max, 3), 1.0,
+                            jnp.float32) * (1.0 + 1e-12 * seed)
+
+        grower_depthwise.histogram_leafbatch = stub
+
+    from bench import make_data
+
+    x, y = make_data(args.rows, args.features)
+    ds = Dataset.from_arrays(x, y, max_bin=args.max_bin)
+
+    cfg = OverallConfig()
+    cfg.set({
+        "objective": "binary", "num_leaves": str(args.leaves),
+        "min_data_in_leaf": "100", "min_sum_hessian_in_leaf": "10.0",
+        "learning_rate": "0.1", "grow_policy": "depthwise",
+        "num_iterations": str(2 * args.iters),
+    }, require_data=False)
+
+    booster = GBDT()
+    booster.init(cfg.boosting_config, ds,
+                 create_objective(cfg.objective_type, cfg.objective_config))
+    booster.train_chunk(args.iters)
+    jax.block_until_ready(booster.score)
+    start = time.time()
+    booster.train_chunk(args.iters)
+    jax.block_until_ready(booster.score)
+    elapsed = time.time() - start
+    if variant == "nohist":
+        grower_depthwise.histogram_leafbatch = real
+    return args.iters / elapsed
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--rows", type=int, default=11_000_000)
+    p.add_argument("--features", type=int, default=28)
+    p.add_argument("--leaves", type=int, default=255)
+    p.add_argument("--max-bin", type=int, default=255)
+    p.add_argument("--iters", type=int, default=8)
+    p.add_argument("--variant", default="full",
+                   choices=["full", "nohist"])
+    args = p.parse_args()
+    rate = run_variant(args.variant, args)
+    print(json.dumps({"variant": args.variant, "rows": args.rows,
+                      "iters_per_sec": round(rate, 4),
+                      "sec_per_iter": round(1.0 / rate, 4)}))
+
+
+if __name__ == "__main__":
+    main()
