@@ -242,7 +242,11 @@ class TreeConfig:
     # TPU tuning knobs (no reference equivalent): row-chunk length of the
     # histogram scan (0 = per-policy default) and the one-hot/value operand
     # dtype of the histogram matmul ("float32" exact, "bfloat16" rounds
-    # grad/hess to 8 mantissa bits before the f32-accumulated matmul)
+    # grad/hess to 8 mantissa bits before the f32-accumulated matmul,
+    # "int8" = quantized-gradient histograms on the int8 MXU via the Pallas
+    # kernel — ~2x faster passes, grad/hess rounded to 1/127 of their
+    # per-pass max; counts stay exact).  hist_chunk tunes the XLA scan
+    # paths only; the int8 Pallas kernel uses its own fixed VMEM block.
     hist_chunk: int = 0
     hist_dtype: str = "float32"
 
@@ -273,8 +277,8 @@ class TreeConfig:
         log.check(self.hist_chunk >= 0, "hist_chunk should be >= 0")
         if "hist_dtype" in params:
             value = params["hist_dtype"].lower()
-            log.check(value in ("float32", "bfloat16"),
-                      "hist_dtype must be float32 or bfloat16")
+            log.check(value in ("float32", "bfloat16", "int8"),
+                      "hist_dtype must be float32, bfloat16 or int8")
             self.hist_dtype = value
 
 

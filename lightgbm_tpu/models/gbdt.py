@@ -1057,6 +1057,10 @@ def _tuning_kwargs(hist_chunk: int, hist_dtype: str) -> dict:
         kwargs["hist_chunk"] = hist_chunk
     if hist_dtype == "bfloat16":
         kwargs["compute_dtype"] = jnp.bfloat16
+    elif hist_dtype == "int8":
+        # string sentinel (hashable jit static): quantized-gradient path,
+        # dispatched per backend in the histogram ops
+        kwargs["compute_dtype"] = "int8"
     return kwargs
 
 

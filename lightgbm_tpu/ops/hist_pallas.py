@@ -1,0 +1,228 @@
+"""Pallas TPU histogram kernel — the hot loop, hand-scheduled.
+
+The XLA one-hot-einsum formulation (ops/histogram.py) runs at ~70% of MXU
+peak and cannot use the int8 MXU path.  This kernel owns the schedule:
+
+- grid over row-chunks; the [F, B, K] accumulator lives in VMEM across the
+  whole grid (written back to HBM once), so HBM traffic is the int8 bin
+  matrix + a packed int8 side-band — nothing else.  All row-aligned inputs
+  are LANE-major or lane-packed: a [N, small] f32 buffer would be
+  tile-padded to 128 lanes in HBM (128 bytes/row of traffic), so grad,
+  hess, mask and column id travel as ONE packed [N, 4] int8 array;
+- per feature, the bin one-hot [chunk, B] is generated in VMEM by an iota
+  compare (never touches HBM) and contracted on the MXU
+  (sublane-contracting dot_general) against the column-expanded value
+  block [chunk, K];
+- ``dtype="int8"`` is the quantized-gradient variant: stochastically /
+  nearest-rounded int8 grad/hess, int8xint8->int32 MXU at 2x the bf16
+  rate, exact int32 counts — modern LightGBM's quantized-training idea
+  recast for a systolic array (the reference's double accumulators,
+  bin.h:15-17, sit at the other end of this precision spectrum).
+
+Layout contract: bins_t [N, F] int8 (row-major TRANSPOSE of the dataset's
+[F, N] bin matrix), packed values [N, 4] int8 (gq, hq, ok, cid), output
+hist [C, F, B, 3] f32 after dequantization.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANES = 128  # value-operand width: 42 leaf columns x 3 stats + 2 pad
+
+
+def _hist_kernel(bins_ref, packed_ref, out_ref, *, F, B, chunk,
+                 compute_dtype, acc_dtype):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    # pure arithmetic (no jnp.where): Mosaic cannot relayout replicated
+    # boolean vectors.  VPU math runs wide (8-bit vector arithmetic is
+    # unsupported) and casts to compute_dtype only for the MXU operands.
+    # Everything is LANE-major ([*, chunk]); the value block vL is built
+    # TRANSPOSED [LANES, chunk] so the contraction is an NT-form matmul.
+    wide = jnp.int32 if compute_dtype == jnp.int8 else jnp.float32
+    jrow = jax.lax.broadcasted_iota(jnp.int32, (LANES, chunk), 0)
+    leaf_j = jrow // 3
+    k_j = jrow - 3 * leaf_j
+    k0 = (k_j == 0).astype(wide)
+    k1 = (k_j == 1).astype(wide)
+    k2 = (k_j == 2).astype(wide)
+    packed = packed_ref[...].astype(jnp.int32)              # [4, chunk]
+    v0 = jnp.broadcast_to(packed[0:1, :], (LANES, chunk)).astype(wide)
+    v1 = jnp.broadcast_to(packed[1:2, :], (LANES, chunk)).astype(wide)
+    v2 = jnp.broadcast_to(packed[2:3, :], (LANES, chunk)).astype(wide)
+    cidb = jnp.broadcast_to(packed[3:4, :], (LANES, chunk))  # i32
+    lmask = (cidb == leaf_j).astype(wide)
+    vLt = ((k0 * v0 + k1 * v1 + k2 * v2) * lmask
+           ).astype(compute_dtype)                          # [LANES, chunk]
+
+    iota_b = jax.lax.broadcasted_iota(jnp.int32, (B, chunk), 0)
+    dn = (((1,), (1,)), ((), ()))                           # contract chunk
+    for f in range(F):
+        # bins ride as int8 bit-patterns; values >= 128 (uint8 source,
+        # max_bin up to 256) wrap negative on the cast, so mask back
+        # (int8-domain compares don't compile in Mosaic)
+        brow = bins_ref[f:f + 1, :].astype(jnp.int32) & 255  # [1, chunk]
+        oh = (iota_b == brow).astype(compute_dtype)         # [B, chunk]
+        out_ref[f] += jax.lax.dot_general(
+            oh, vLt, dimension_numbers=dn,
+            preferred_element_type=acc_dtype)               # [B, LANES]
+
+
+@functools.partial(jax.jit, static_argnames=("B", "chunk", "dtype"))
+def hist_pallas_raw(bins, packed, *, B: int, chunk: int = 2048,
+                    dtype: str = "int8"):
+    """[F, B, LANES] accumulator from [F, N] bins and [4, N] packed values.
+
+    Rows must be pre-padded to a multiple of ``chunk`` (pad cid with -1).
+    packed int8 rows: (grad_q, hess_q, ok, cid) — for the bf16 variant the
+    same int8 levels ride bf16 operands (integers <= 127 are bf16-exact),
+    so both dtypes produce bit-identical histograms.  ``bins`` may carry
+    uint8 bit-patterns (the kernel masks the sign-extension back off).
+    """
+    F, N = bins.shape
+    assert N % chunk == 0
+    compute_dtype = jnp.int8 if dtype == "int8" else jnp.bfloat16
+    acc_dtype = jnp.int32 if dtype == "int8" else jnp.float32
+    kernel = functools.partial(
+        _hist_kernel, F=F, B=B, chunk=chunk,
+        compute_dtype=compute_dtype, acc_dtype=acc_dtype)
+    grid = N // chunk
+    out = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((F, chunk), lambda i: (0, i)),
+            pl.BlockSpec((4, chunk), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((F, B, LANES), lambda i: (0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((F, B, LANES), acc_dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+    )(bins, packed)
+    if dtype == "int8":
+        return out
+    return out.astype(jnp.int32)
+
+
+def quantize_values(grad, hess, col_ok, rng_bits=None):
+    """int8 quantization of grad/hess with a per-pass global scale.
+
+    Round-to-nearest by default; unbiased stochastic rounding (floor(y+u))
+    when ``rng_bits`` [2, N] uint32 is given.  Returns (vals [3, N] int8
+    lane-major, scale [3] f32) — the count row is exact by construction.
+    """
+    okf = col_ok.astype(jnp.float32)
+    gs = jnp.maximum(jnp.max(jnp.abs(grad)), 1e-30) / 127.0
+    hs = jnp.maximum(jnp.max(jnp.abs(hess)), 1e-30) / 127.0
+
+    def quant(x, s, bits):
+        y = x / s
+        if bits is None:
+            q = jnp.round(y)
+        else:
+            u = (bits >> 8).astype(jnp.float32) * (1.0 / (1 << 24))
+            q = jnp.floor(y + u)
+        return jnp.clip(q, -127, 127)
+
+    gq = quant(grad, gs, None if rng_bits is None else rng_bits[0])
+    hq = quant(hess, hs, None if rng_bits is None else rng_bits[1])
+    vals = jnp.stack([gq * okf, hq * okf, okf], axis=0).astype(jnp.int8)
+    return vals, jnp.stack([gs, hs, jnp.float32(1.0)])
+
+
+def _grouped(fn, bins, grad, hess, col_id, col_ok, num_cols, B, **kw):
+    """Split wider-than-42 levels into single-MXU-tile groups (the same
+    rule as ops/histogram.histogram_leafbatch)."""
+    if num_cols <= 42:
+        return fn(bins, grad, hess, col_id, col_ok, num_cols, B, **kw)
+    n_groups = -(-num_cols // 42)
+    width = -(-num_cols // n_groups)
+    parts = []
+    for base in range(0, num_cols, width):
+        k = min(width, num_cols - base)
+        ok = col_ok & (col_id >= base) & (col_id < base + k)
+        parts.append(fn(bins, grad, hess, col_id - base, ok, k, B, **kw))
+    return jnp.concatenate(parts, axis=0)
+
+
+def hist_pallas_leafbatch(bins, grad, hess, col_id, col_ok, num_cols: int,
+                          num_bins_max: int, *, chunk: int = 2048,
+                          dtype: str = "int8", rng_bits=None):
+    """Drop-in histogram_leafbatch equivalent on the Pallas kernel.
+
+    ``bins`` is the usual [F, N] matrix (int8 or uint8).  The int32
+    accumulator dequantizes to the usual [C, F, B, 3] f32."""
+    return _grouped(_hist_pallas_one, bins, grad, hess, col_id, col_ok,
+                    num_cols, num_bins_max, chunk=chunk, dtype=dtype,
+                    rng_bits=rng_bits)
+
+
+def _hist_pallas_one(bins, grad, hess, col_id, col_ok, num_cols, B, *,
+                     chunk, dtype, rng_bits):
+    F, N = bins.shape
+    vals, scale = quantize_values(grad, hess, col_ok, rng_bits)
+    cid8 = jnp.where(col_ok, col_id, -1).astype(jnp.int8)
+    packed = jnp.concatenate([vals, cid8[None, :]], axis=0)  # [4, N] int8
+
+    pad = (-N) % chunk
+    if pad:
+        bins = jnp.pad(bins, ((0, 0), (0, pad)))
+        packed = jnp.pad(packed, ((0, 0), (0, pad)), constant_values=-1)
+    acc = hist_pallas_raw(bins.astype(jnp.int8), packed, B=B,
+                          chunk=chunk, dtype=dtype)          # [F, B, LANES]
+    hist = acc[:, :, :num_cols * 3].astype(jnp.float32)
+    hist = hist.reshape(F, B, num_cols, 3).transpose(2, 0, 1, 3)
+    return hist * scale
+
+
+def hist_quant_xla(bins, grad, hess, col_id, col_ok, num_cols: int,
+                   num_bins_max: int, *, chunk: int = 65536, rng_bits=None):
+    """XLA reference of the SAME quantized-gradient math as the Pallas int8
+    kernel (bit-identical output) — the CPU-testable oracle and the
+    fallback on non-TPU backends."""
+    return _grouped(_hist_quant_xla_one, bins, grad, hess, col_id, col_ok,
+                    num_cols, num_bins_max, chunk=chunk, rng_bits=rng_bits)
+
+
+def _hist_quant_xla_one(bins, grad, hess, col_id, col_ok, num_cols, B, *,
+                        chunk, rng_bits):
+    F, N = bins.shape
+    C = num_cols
+    # don't pad a small input up to a full default chunk
+    chunk = min(chunk, max(256, -(-N // 256) * 256))
+    vals, scale = quantize_values(grad, hess, col_ok, rng_bits)
+    cid = jnp.where(col_ok, col_id, -1).astype(jnp.int32)
+    pad = (-N) % chunk
+    if pad:
+        bins = jnp.pad(bins, ((0, 0), (0, pad)))
+        vals = jnp.pad(vals, ((0, 0), (0, pad)))
+        cid = jnp.pad(cid, (0, pad), constant_values=-1)
+    n_chunks = (N + pad) // chunk
+    bins_c = bins.astype(jnp.int32).reshape(F, n_chunks, chunk).transpose(1, 0, 2)
+    vals_c = vals.astype(jnp.int32).T.reshape(n_chunks, chunk, 3)
+    cid_c = cid.reshape(n_chunks, chunk)
+    ib = jnp.arange(B, dtype=jnp.int32)
+    ic = jnp.arange(C, dtype=jnp.int32)
+
+    def body(carry, xs):
+        bc, vc, cc = xs
+        oh = (bc[:, :, None] == ib).astype(jnp.int32)
+        lsel = (cc[:, None] == ic).astype(jnp.int32)
+        vL = (lsel[:, :, None] * vc[:, None, :]).reshape(chunk, C * 3)
+        out = jnp.einsum("fcb,ck->fbk", oh, vL,
+                         preferred_element_type=jnp.int32)
+        return carry + out, None
+
+    init = jnp.zeros((F, B, C * 3), jnp.int32)
+    hist, _ = jax.lax.scan(body, init, (bins_c, vals_c, cid_c))
+    hist = hist.reshape(F, B, C, 3).transpose(2, 0, 1, 3).astype(jnp.float32)
+    return hist * scale

@@ -126,6 +126,16 @@ def histogram_leafbatch(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     -------
     hist : [C, F, B, 3] f32
     """
+    if compute_dtype == "int8":
+        # quantized-gradient path: Pallas int8-MXU kernel on TPU, the
+        # bit-identical XLA formulation elsewhere (ops/hist_pallas.py)
+        import jax as _jax
+        from .hist_pallas import hist_pallas_leafbatch, hist_quant_xla
+        if _jax.default_backend() == "tpu":
+            return hist_pallas_leafbatch(bins, grad, hess, col_id, col_ok,
+                                         num_cols, num_bins_max)
+        return hist_quant_xla(bins, grad, hess, col_id, col_ok, num_cols,
+                              num_bins_max, chunk=chunk)
     F, N = bins.shape
     B = num_bins_max
     # cap the pass at ONE 128-lane tile of the value operand (42 histogram
@@ -192,6 +202,50 @@ def histogram_leafbatch(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     return hist[:num_cols]
 
 
+def histogram_leafbatch_segsum(bins, grad, hess, col_id, col_ok,
+                               num_cols: int, num_bins_max: int,
+                               chunk: int = 0, compute_dtype=None):
+    """Scatter-add leaf-batched histogram — CPU-fast oracle with the same
+    [C, F, B, 3] contract as histogram_leafbatch (scatter beats the dense
+    one-hot matmul off-TPU; summation ORDER differs, so f32 sums match the
+    matmul only to reduction noise)."""
+    F, N = bins.shape
+    B = num_bins_max
+    C = num_cols
+    okf = col_ok.astype(jnp.float32)
+    cid = jnp.where(col_ok, col_id, C).astype(jnp.int32)  # C = drop bucket
+    ids = (cid[None, :] * F + jnp.arange(F, dtype=jnp.int32)[:, None]) * B \
+        + bins.astype(jnp.int32)
+    vals = jnp.stack([grad * okf, hess * okf, okf], axis=1)      # [N, 3]
+    vals = jnp.broadcast_to(vals[None], (F, N, 3)).reshape(-1, 3)
+    hist = jax.ops.segment_sum(vals, ids.reshape(-1),
+                               num_segments=(C + 1) * F * B)
+    return hist.reshape(C + 1, F, B, 3)[:C]
+
+
+def hist_quant_segsum(bins, grad, hess, col_id, col_ok, num_cols: int,
+                      num_bins_max: int, chunk: int = 0, rng_bits=None,
+                      compute_dtype=None):
+    """Scatter-add variant of the quantized-gradient histogram — exact
+    int32 accumulation, so it is bit-identical to hist_pallas/hist_quant_xla
+    (ops/hist_pallas.py) at any summation order; the CPU-fast oracle for
+    int8-path quality tests."""
+    from .hist_pallas import quantize_values
+    F, N = bins.shape
+    B = num_bins_max
+    C = num_cols
+    vals, scale = quantize_values(grad, hess, col_ok, rng_bits)  # [3, N] i8
+    cid = jnp.where(col_ok, col_id, C).astype(jnp.int32)
+    ids = (cid[None, :] * F + jnp.arange(F, dtype=jnp.int32)[:, None]) * B \
+        + bins.astype(jnp.int32)
+    v = jnp.broadcast_to(vals.T.astype(jnp.int32)[None],
+                         (F, N, 3)).reshape(-1, 3)
+    hist = jax.ops.segment_sum(v, ids.reshape(-1),
+                               num_segments=(C + 1) * F * B)
+    hist = hist.reshape(C + 1, F, B, 3)[:C].astype(jnp.float32)
+    return hist * scale
+
+
 def histogram_segsum(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                      mask: jax.Array, num_bins_max: int) -> jax.Array:
     """Scatter-add backend (CPU-friendly, used by tests as an oracle)."""
@@ -209,6 +263,14 @@ def histogram_segsum(bins: jax.Array, grad: jax.Array, hess: jax.Array,
 def build_histogram(bins, grad, hess, mask, num_bins_max, *,
                     backend: str = "matmul", chunk: int = 16384,
                     compute_dtype=jnp.float32) -> jax.Array:
+    if compute_dtype == "int8":
+        # single-leaf quantized pass == leaf-batched with one column
+        N = bins.shape[1]
+        cid = jnp.zeros((N,), jnp.int32)
+        out = histogram_leafbatch(bins, grad, hess, cid, mask, 1,
+                                  num_bins_max, chunk=chunk,
+                                  compute_dtype="int8")
+        return out[0]
     if backend == "matmul":
         return histogram_matmul(bins, grad, hess, mask, num_bins_max,
                                 chunk=chunk, compute_dtype=compute_dtype)

@@ -55,6 +55,8 @@ def main() -> int:
     ap.add_argument("--iters", type=int, default=100)
     ap.add_argument("--grow-policy", default="depthwise",
                     choices=["depthwise", "leafwise"])
+    ap.add_argument("--hist-dtype", default="float32",
+                    choices=["float32", "bfloat16", "int8"])
     args = ap.parse_args()
 
     x, y = make_data(args.rows + args.test_rows, 28)
@@ -77,6 +79,7 @@ def main() -> int:
     cfg = OverallConfig()
     cfg.set({**{k: str(v) for k, v in conf_common.items()},
              "num_iterations": str(args.iters),
+             "hist_dtype": args.hist_dtype,
              "grow_policy": args.grow_policy}, require_data=False)
     booster = GBDT()
     booster.init(cfg.boosting_config, ds,
@@ -91,7 +94,8 @@ def main() -> int:
     t_ours = time.time() - t0
     ours_scores = booster.predict_raw(xte)
     ours_auc = auc_manual(yte, ours_scores)
-    print(f"ours[{args.grow_policy}]: {args.iters} iters in {t_ours:.1f}s "
+    print(f"ours[{args.grow_policy}/{args.hist_dtype}]: "
+          f"{args.iters} iters in {t_ours:.1f}s "
           f"wall incl. jit compile (bench.py reports steady-state "
           f"throughput), test AUC {ours_auc:.6f}", flush=True)
 

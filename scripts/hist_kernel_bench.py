@@ -1,0 +1,72 @@
+"""Microbench histogram-pass formulations on the real TPU.
+
+Variants (all build the same [C, F, B, 3]-shaped level histogram):
+  bf16   : current histogram_leafbatch (one-hot x values, bf16 operands)
+  int8   : quantized-gradient pass — values stochastically rounded to int8
+           per column, one-hot generated int8, int8xint8->int32 MXU matmul,
+           dequantized f32 result (modern LightGBM's quantized-training
+           idea recast as an MXU matmul)
+
+Usage: python scripts/hist_kernel_bench.py --rows 4000000 --cols 42
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from lightgbm_tpu.ops.histogram import histogram_leafbatch
+from scripts.tpu_timeit import device_time
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--rows", type=int, default=4_000_000)
+    p.add_argument("--features", type=int, default=28)
+    p.add_argument("--bins", type=int, default=256)
+    p.add_argument("--cols", type=int, default=42)
+    p.add_argument("--chunk", type=int, default=65536)
+    p.add_argument("--variants", default="bf16,int8")
+    p.add_argument("--pallas-chunk", type=int, default=2048)
+    args = p.parse_args()
+
+    rng = np.random.RandomState(0)
+    N, F, B, C = args.rows, args.features, args.bins, args.cols
+    bins = jnp.asarray(rng.randint(0, B, size=(F, N), dtype=np.int32)
+                       .astype(np.int8))
+    grad = jnp.asarray(rng.randn(N).astype(np.float32) * 0.3)
+    hess = jnp.asarray(rng.rand(N).astype(np.float32) * 0.25)
+    col_id = jnp.asarray(rng.randint(0, C, size=N).astype(np.int32))
+    col_ok = jnp.asarray(rng.rand(N) < 0.9)
+
+    per_pass_bytes = N * (F + 13)  # bins int8 + g/h f32 + colid i32 + ok
+    for v in args.variants.split(","):
+        if v == "bf16":
+            op = lambda g, h: histogram_leafbatch(
+                bins, g, h, col_id, col_ok, C, B, chunk=args.chunk)
+        elif v == "int8":
+            from lightgbm_tpu.ops.hist_pallas import hist_quant_xla
+            op = lambda g, h: hist_quant_xla(
+                bins, g, h, col_id, col_ok, C, B, chunk=args.chunk)
+        elif v.startswith("pallas"):
+            from lightgbm_tpu.ops.hist_pallas import hist_pallas_leafbatch
+            dt = "int8" if v.endswith("int8") else "bfloat16"
+            ck = args.pallas_chunk
+            op = lambda g, h: hist_pallas_leafbatch(
+                bins, g, h, col_id, col_ok, C, B, chunk=ck, dtype=dt)
+        else:
+            raise SystemExit(f"unknown variant {v}")
+        t = device_time(op, grad, hess, key_arg=0, reps=(2, 6))
+        gbps = per_pass_bytes / t / 1e9
+        print(f"{v:6s} rows={N} C={C} chunk={args.chunk}: "
+              f"{t*1e3:8.2f} ms/pass  ({gbps:6.1f} GB/s effective)")
+
+
+if __name__ == "__main__":
+    main()

@@ -17,10 +17,41 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+import shutil
+import subprocess
+
 import numpy as np
 import pytest
 
 REFERENCE_EXAMPLES = "/root/reference/examples"
+REFERENCE_SRC = "/root/reference"
+REFERENCE_BUILD = "/tmp/lightgbm_reference_build"
+REFERENCE_BINARY = os.path.join(REFERENCE_BUILD, "lightgbm")
+
+
+@pytest.fixture(scope="session")
+def reference_binary():
+    """Compile the reference from source once per session (differential
+    oracle, SURVEY §4); skip when source/toolchain are unavailable."""
+    if os.path.exists(REFERENCE_BINARY):
+        return REFERENCE_BINARY
+    if not os.path.isdir(os.path.join(REFERENCE_SRC, "src")):
+        pytest.skip("reference source not available")
+    if shutil.which("cmake") is None or shutil.which("make") is None:
+        pytest.skip("no native toolchain")
+    shutil.copytree(REFERENCE_SRC, REFERENCE_BUILD, dirs_exist_ok=True,
+                    ignore=shutil.ignore_patterns(".git", "windows"))
+    bdir = os.path.join(REFERENCE_BUILD, "build")
+    os.makedirs(bdir, exist_ok=True)
+    try:
+        subprocess.run(["cmake", "..", "-DCMAKE_BUILD_TYPE=Release"],
+                       cwd=bdir, check=True, capture_output=True)
+        subprocess.run(["make", f"-j{os.cpu_count()}"], cwd=bdir,
+                       check=True, capture_output=True)
+    except subprocess.CalledProcessError as e:  # pragma: no cover
+        pytest.skip(f"reference build failed: {e.stderr[-500:]}")
+    assert os.path.exists(REFERENCE_BINARY)
+    return REFERENCE_BINARY
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,116 @@
+"""Quantized-gradient (int8) histogram path: XLA oracle ≡ Pallas kernel,
+exact counts, and end-to-end training sanity.
+
+The int8 path is the TPU throughput option (ops/hist_pallas.py): grad/hess
+are rounded to 1/127 of their per-pass max and contracted on the int8 MXU.
+The reference accumulates in double (bin.h:15-17); LightGBM's later
+quantized-training work showed coarse gradient quantization preserves model
+quality — these tests pin the machinery, scripts/auc_parity.py pins quality
+at scale.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from lightgbm_tpu.ops.histogram import histogram_leafbatch
+from lightgbm_tpu.ops.hist_pallas import (hist_pallas_leafbatch,
+                                          hist_quant_xla, quantize_values)
+
+
+@pytest.fixture(scope="module")
+def hist_inputs():
+    rng = np.random.RandomState(3)
+    F, N, B, C = 6, 5000, 32, 9
+    bins = jnp.asarray(rng.randint(0, B, (F, N)).astype(np.int8))
+    grad = jnp.asarray((rng.randn(N) * 0.4).astype(np.float32))
+    hess = jnp.asarray((rng.rand(N) * 0.25).astype(np.float32))
+    cid = jnp.asarray(rng.randint(0, C, N).astype(np.int32))
+    ok = jnp.asarray(rng.rand(N) < 0.85)
+    return bins, grad, hess, cid, ok, F, N, B, C
+
+
+def test_xla_quant_matches_pallas_interpret(hist_inputs):
+    from jax.experimental.pallas import tpu as pltpu
+    bins, grad, hess, cid, ok, F, N, B, C = hist_inputs
+    via_xla = hist_quant_xla(bins, grad, hess, cid, ok, C, B)
+    with pltpu.force_tpu_interpret_mode():
+        via_pl = hist_pallas_leafbatch(bins, grad, hess, cid, ok, C, B,
+                                       chunk=1024, dtype="int8")
+    np.testing.assert_array_equal(np.asarray(via_xla), np.asarray(via_pl))
+
+
+def test_quantized_counts_exact_and_sums_close(hist_inputs):
+    bins, grad, hess, cid, ok, F, N, B, C = hist_inputs
+    exact = histogram_leafbatch(bins, grad, hess, cid, ok, C, B,
+                                compute_dtype=jnp.float32)
+    quant = hist_quant_xla(bins, grad, hess, cid, ok, C, B)
+    np.testing.assert_array_equal(np.asarray(exact[..., 2]),
+                                  np.asarray(quant[..., 2]))
+    # per-cell error bounded by n_cell * scale/2 (round-to-nearest)
+    gscale = float(jnp.max(jnp.abs(grad))) / 127.0
+    counts = np.asarray(exact[..., 2])
+    err = np.abs(np.asarray(exact[..., 0]) - np.asarray(quant[..., 0]))
+    assert (err <= 0.5 * gscale * counts + 1e-5).all()
+
+
+def test_dispatch_through_leafbatch(hist_inputs):
+    bins, grad, hess, cid, ok, F, N, B, C = hist_inputs
+    a = histogram_leafbatch(bins, grad, hess, cid, ok, C, B,
+                            compute_dtype="int8")
+    b = hist_quant_xla(bins, grad, hess, cid, ok, C, B)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_uint8_bins_above_127_not_dropped():
+    """Production max_bin=255 stores bins as uint8 with values up to 254;
+    the Pallas kernel must mask the int8 sign-extension back off (a plain
+    int8 cast wraps 200 -> -56 and silently drops the row)."""
+    from jax.experimental.pallas import tpu as pltpu
+    rng = np.random.RandomState(9)
+    F, N, B, C = 4, 3000, 255, 5
+    bins = jnp.asarray(rng.randint(0, B, (F, N)).astype(np.uint8))
+    grad = jnp.asarray(rng.randn(N).astype(np.float32))
+    hess = jnp.asarray(rng.rand(N).astype(np.float32))
+    cid = jnp.asarray(rng.randint(0, C, N).astype(np.int32))
+    ok = jnp.ones(N, bool)
+    via_xla = hist_quant_xla(bins, grad, hess, cid, ok, C, B)
+    with pltpu.force_tpu_interpret_mode():
+        via_pl = hist_pallas_leafbatch(bins, grad, hess, cid, ok, C, B,
+                                       chunk=1024, dtype="int8")
+    np.testing.assert_array_equal(np.asarray(via_xla), np.asarray(via_pl))
+    # every row must land somewhere: total count == N per feature
+    assert float(via_pl[..., 2].sum()) == float(N * F)
+
+
+def test_stochastic_rounding_unbiased(hist_inputs):
+    bins, grad, hess, cid, ok, F, N, B, C = hist_inputs
+    key = jax.random.PRNGKey(0)
+    bits = jax.random.bits(key, (2, N), jnp.uint32)
+    vals, scale = quantize_values(grad, hess, ok, rng_bits=bits)
+    # SR keeps values within 1 ulp and is mean-preserving to ~sqrt(N) noise
+    g_deq = np.asarray(vals[0], np.float32) * float(scale[0])
+    gm = np.asarray(grad) * np.asarray(ok, np.float32)
+    assert np.abs(g_deq - gm).max() <= float(scale[0]) + 1e-7
+    assert abs((g_deq - gm).sum()) < float(scale[0]) * np.sqrt(N) * 4
+
+
+def test_train_depthwise_int8_quality(synthetic_binary):
+    """End-to-end: int8 histograms must reach f32-comparable train error."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.io.dataset import Dataset
+    x, y = synthetic_binary
+
+    def train(hist_dtype):
+        ds = Dataset.from_arrays(x, y, max_bin=64)
+        params = {"objective": "binary", "num_leaves": "31",
+                  "min_data_in_leaf": "20", "min_sum_hessian_in_leaf": "1.0",
+                  "num_iterations": "30", "learning_rate": "0.1",
+                  "grow_policy": "depthwise", "hist_dtype": hist_dtype}
+        booster = lgb.train(params, ds)
+        p = booster.predict(x)
+        return float(np.mean((p > 0.5) != (y > 0.5)))
+
+    err_f32 = train("float32")
+    err_int8 = train("int8")
+    assert err_int8 <= err_f32 + 0.02, (err_f32, err_int8)

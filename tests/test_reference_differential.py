@@ -29,31 +29,10 @@ import numpy as np
 import pytest
 
 REFERENCE = "/root/reference"
-BUILD_SRC = "/tmp/lightgbm_reference_build"
-BINARY = os.path.join(BUILD_SRC, "lightgbm")
 
 
-@pytest.fixture(scope="session")
-def reference_binary():
-    if os.path.exists(BINARY):
-        return BINARY
-    if not os.path.isdir(os.path.join(REFERENCE, "src")):
-        pytest.skip("reference source not available")
-    if shutil.which("cmake") is None or shutil.which("make") is None:
-        pytest.skip("no native toolchain")
-    shutil.copytree(REFERENCE, BUILD_SRC, dirs_exist_ok=True,
-                    ignore=shutil.ignore_patterns(".git", "windows"))
-    bdir = os.path.join(BUILD_SRC, "build")
-    os.makedirs(bdir, exist_ok=True)
-    try:
-        subprocess.run(["cmake", "..", "-DCMAKE_BUILD_TYPE=Release"],
-                       cwd=bdir, check=True, capture_output=True)
-        subprocess.run(["make", f"-j{os.cpu_count()}"], cwd=bdir,
-                       check=True, capture_output=True)
-    except subprocess.CalledProcessError as e:  # pragma: no cover
-        pytest.skip(f"reference build failed: {e.stderr[-500:]}")
-    assert os.path.exists(BINARY)
-    return BINARY
+# reference_binary fixture lives in conftest.py (shared with
+# test_auc_parity.py)
 
 
 DET = ["feature_fraction=1.0", "bagging_fraction=1.0", "bagging_freq=0",
