@@ -23,6 +23,7 @@ import jax.numpy as jnp
 
 from ..utils import log
 from ..ops.scoring import add_tree_score
+from ..ops.lookup import exact_table_lookup as _leaf_lookup
 from .grower import grow_tree
 from .tree import Tree
 
@@ -217,7 +218,8 @@ class GBDT:
             # waiting for num_leaves on the host
             shrunk = jnp.where(tree_arrays.num_leaves > 1,
                                tree_arrays.leaf_value * lr, 0.0)
-            self.score = self.score.at[cls].add(shrunk[tree_arrays.leaf_ids])
+            self.score = self.score.at[cls].add(
+                _leaf_lookup(shrunk, tree_arrays.leaf_ids))
             # valid scores via tree replay (gbdt.cpp:220-222); the grower's
             # arrays are already statically padded to num_leaves-1, so the
             # replay jit compiles once and uses no host data
@@ -976,7 +978,7 @@ def make_chunk_body(*, grad_fn, obj_params, num_class: int, lrf, grow_fn,
             fm = fmask[cls] if has_ff else jnp.ones((F,), jnp.bool_)
             ta = grow_fn(bins, grad[cls], hess[cls], rm, fm, num_bins)
             shrunk = jnp.where(ta.num_leaves > 1, ta.leaf_value * lrf, 0.0)
-            score = score.at[cls].add(shrunk[ta.leaf_ids])
+            score = score.at[cls].add(_leaf_lookup(shrunk, ta.leaf_ids))
             # valid scores by tree replay (gbdt.cpp:220-222)
             for v in range(n_valid):
                 vscores[v] = vscores[v].at[cls].set(add_tree_score(

@@ -204,11 +204,17 @@ def grow_tree_depthwise(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                            small_is_right.astype(f32)], axis=1)  # [P, 5]
         lsel = (slot_id[None, :] ==
                 jnp.arange(P, dtype=i32)[:, None]).astype(f32)   # [P, N]
-        # HIGHEST precision: the table carries integer ids (feature,
-        # threshold, leaf); default TPU matmul precision truncates f32
-        # operands to bf16 and would corrupt ids > 256
+        # The table carries integer ids (feature, threshold, leaf).  Default
+        # TPU matmul precision truncates f32 operands to bf16, which is
+        # EXACT for integers <= 256 — and exactly one lsel entry matches
+        # per row, so there is no accumulation error either.  Only configs
+        # with ids beyond 256 need the 6-pass HIGHEST decomposition
+        # (measured 2.27 ms vs 0.72 ms per level at 11M rows).
+        ids_bf16_exact = max(F, B, L) <= 256
+        attr_prec = (None if ids_bf16_exact
+                     else jax.lax.Precision.HIGHEST)
         attrs = jnp.einsum("pn,pk->kn", lsel, table,
-                           precision=jax.lax.Precision.HIGHEST,
+                           precision=attr_prec,
                            preferred_element_type=jnp.float32)   # [5, N]
         feat_row = attrs[0].astype(i32)
         thr_row = attrs[1].astype(i32)
@@ -223,9 +229,11 @@ def grow_tree_depthwise(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         Fg = partition_bins.shape[0]
         if Fg <= 128:
             fsel = (feat_row[None, :] == jnp.arange(Fg, dtype=i32)[:, None])
+            # bins < 256 are bf16-exact and one fsel entry matches per row
             row_bin = jnp.einsum(
                 "fn,fn->n", fsel.astype(f32), partition_bins.astype(f32),
-                precision=jax.lax.Precision.HIGHEST).astype(i32)
+                precision=(None if B <= 256
+                           else jax.lax.Precision.HIGHEST)).astype(i32)
         else:
             row_bin = jnp.take_along_axis(
                 partition_bins, feat_row[None, :], axis=0)[0].astype(i32)

@@ -32,10 +32,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-LANES = 128  # value-operand width: 42 leaf columns x 3 stats + 2 pad
+LANES = 128  # default value-operand width: 42 leaf columns x 3 stats + 2
 
 
-def _hist_kernel(bins_ref, packed_ref, out_ref, *, F, B, chunk,
+def _hist_kernel(bins_ref, packed_ref, out_ref, *, F, B, chunk, lanes,
                  compute_dtype, acc_dtype):
     i = pl.program_id(0)
 
@@ -47,22 +47,22 @@ def _hist_kernel(bins_ref, packed_ref, out_ref, *, F, B, chunk,
     # boolean vectors.  VPU math runs wide (8-bit vector arithmetic is
     # unsupported) and casts to compute_dtype only for the MXU operands.
     # Everything is LANE-major ([*, chunk]); the value block vL is built
-    # TRANSPOSED [LANES, chunk] so the contraction is an NT-form matmul.
+    # TRANSPOSED [lanes, chunk] so the contraction is an NT-form matmul.
     wide = jnp.int32 if compute_dtype == jnp.int8 else jnp.float32
-    jrow = jax.lax.broadcasted_iota(jnp.int32, (LANES, chunk), 0)
+    jrow = jax.lax.broadcasted_iota(jnp.int32, (lanes, chunk), 0)
     leaf_j = jrow // 3
     k_j = jrow - 3 * leaf_j
     k0 = (k_j == 0).astype(wide)
     k1 = (k_j == 1).astype(wide)
     k2 = (k_j == 2).astype(wide)
     packed = packed_ref[...].astype(jnp.int32)              # [4, chunk]
-    v0 = jnp.broadcast_to(packed[0:1, :], (LANES, chunk)).astype(wide)
-    v1 = jnp.broadcast_to(packed[1:2, :], (LANES, chunk)).astype(wide)
-    v2 = jnp.broadcast_to(packed[2:3, :], (LANES, chunk)).astype(wide)
-    cidb = jnp.broadcast_to(packed[3:4, :], (LANES, chunk))  # i32
+    v0 = jnp.broadcast_to(packed[0:1, :], (lanes, chunk)).astype(wide)
+    v1 = jnp.broadcast_to(packed[1:2, :], (lanes, chunk)).astype(wide)
+    v2 = jnp.broadcast_to(packed[2:3, :], (lanes, chunk)).astype(wide)
+    cidb = jnp.broadcast_to(packed[3:4, :], (lanes, chunk))  # i32
     lmask = (cidb == leaf_j).astype(wide)
     vLt = ((k0 * v0 + k1 * v1 + k2 * v2) * lmask
-           ).astype(compute_dtype)                          # [LANES, chunk]
+           ).astype(compute_dtype)                          # [lanes, chunk]
 
     iota_b = jax.lax.broadcasted_iota(jnp.int32, (B, chunk), 0)
     dn = (((1,), (1,)), ((), ()))                           # contract chunk
@@ -77,23 +77,25 @@ def _hist_kernel(bins_ref, packed_ref, out_ref, *, F, B, chunk,
             preferred_element_type=acc_dtype)               # [B, LANES]
 
 
-@functools.partial(jax.jit, static_argnames=("B", "chunk", "dtype"))
+@functools.partial(jax.jit, static_argnames=("B", "chunk", "dtype", "lanes"))
 def hist_pallas_raw(bins, packed, *, B: int, chunk: int = 2048,
-                    dtype: str = "int8"):
-    """[F, B, LANES] accumulator from [F, N] bins and [4, N] packed values.
+                    dtype: str = "int8", lanes: int = LANES):
+    """[F, B, lanes] accumulator from [F, N] bins and [4, N] packed values.
 
     Rows must be pre-padded to a multiple of ``chunk`` (pad cid with -1).
     packed int8 rows: (grad_q, hess_q, ok, cid) — for the bf16 variant the
     same int8 levels ride bf16 operands (integers <= 127 are bf16-exact),
     so both dtypes produce bit-identical histograms.  ``bins`` may carry
     uint8 bit-patterns (the kernel masks the sign-extension back off).
+    ``lanes`` widens the value operand past one MXU tile (192 fits 64 leaf
+    columns in 1.5 tiles instead of two full 128-lane passes).
     """
     F, N = bins.shape
-    assert N % chunk == 0
+    assert N % chunk == 0 and packed.shape == (4, N)
     compute_dtype = jnp.int8 if dtype == "int8" else jnp.bfloat16
     acc_dtype = jnp.int32 if dtype == "int8" else jnp.float32
     kernel = functools.partial(
-        _hist_kernel, F=F, B=B, chunk=chunk,
+        _hist_kernel, F=F, B=B, chunk=chunk, lanes=lanes,
         compute_dtype=compute_dtype, acc_dtype=acc_dtype)
     grid = N // chunk
     out = pl.pallas_call(
@@ -103,8 +105,8 @@ def hist_pallas_raw(bins, packed, *, B: int, chunk: int = 2048,
             pl.BlockSpec((F, chunk), lambda i: (0, i)),
             pl.BlockSpec((4, chunk), lambda i: (0, i)),
         ],
-        out_specs=pl.BlockSpec((F, B, LANES), lambda i: (0, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((F, B, LANES), acc_dtype),
+        out_specs=pl.BlockSpec((F, B, lanes), lambda i: (0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((F, B, lanes), acc_dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",)),
     )(bins, packed)
@@ -160,15 +162,30 @@ def hist_pallas_leafbatch(bins, grad, hess, col_id, col_ok, num_cols: int,
     """Drop-in histogram_leafbatch equivalent on the Pallas kernel.
 
     ``bins`` is the usual [F, N] matrix (int8 or uint8).  The int32
-    accumulator dequantizes to the usual [C, F, B, 3] f32."""
-    return _grouped(_hist_pallas_one, bins, grad, hess, col_id, col_ok,
-                    num_cols, num_bins_max, chunk=chunk, dtype=dtype,
-                    rng_bits=rng_bits)
+    accumulator dequantizes to the usual [C, F, B, 3] f32.  Levels up to
+    64 columns run as ONE pass (<=42 columns fill one 128-lane MXU tile;
+    43-64 use a 192-lane operand = 1.5 tiles, cheaper than two full
+    passes over the data); wider levels split into 64-column groups."""
+    if num_cols <= 64:
+        return _hist_pallas_one(bins, grad, hess, col_id, col_ok, num_cols,
+                                num_bins_max, chunk=chunk, dtype=dtype,
+                                rng_bits=rng_bits)
+    n_groups = -(-num_cols // 64)
+    width = -(-num_cols // n_groups)
+    parts = []
+    for base in range(0, num_cols, width):
+        k = min(width, num_cols - base)
+        ok = col_ok & (col_id >= base) & (col_id < base + k)
+        parts.append(_hist_pallas_one(
+            bins, grad, hess, col_id - base, ok, k, num_bins_max,
+            chunk=chunk, dtype=dtype, rng_bits=rng_bits))
+    return jnp.concatenate(parts, axis=0)
 
 
 def _hist_pallas_one(bins, grad, hess, col_id, col_ok, num_cols, B, *,
                      chunk, dtype, rng_bits):
     F, N = bins.shape
+    lanes = LANES if num_cols <= 42 else 192
     vals, scale = quantize_values(grad, hess, col_ok, rng_bits)
     cid8 = jnp.where(col_ok, col_id, -1).astype(jnp.int8)
     packed = jnp.concatenate([vals, cid8[None, :]], axis=0)  # [4, N] int8
@@ -178,7 +195,8 @@ def _hist_pallas_one(bins, grad, hess, col_id, col_ok, num_cols, B, *,
         bins = jnp.pad(bins, ((0, 0), (0, pad)))
         packed = jnp.pad(packed, ((0, 0), (0, pad)), constant_values=-1)
     acc = hist_pallas_raw(bins.astype(jnp.int8), packed, B=B,
-                          chunk=chunk, dtype=dtype)          # [F, B, LANES]
+                          chunk=chunk, dtype=dtype,
+                          lanes=lanes)                       # [F, B, lanes]
     hist = acc[:, :, :num_cols * 3].astype(jnp.float32)
     hist = hist.reshape(F, B, num_cols, 3).transpose(2, 0, 1, 3)
     return hist * scale

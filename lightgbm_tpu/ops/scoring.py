@@ -14,6 +14,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from .lookup import exact_table_lookup
+
 
 @functools.partial(jax.jit, static_argnames=("max_nodes",))
 def leaf_ids_by_replay(bins: jax.Array, split_feature: jax.Array,
@@ -106,7 +108,7 @@ def ensemble_scores(codes: jax.Array, split_feature: jax.Array,
                                          num_nodes=nl - 1)
         leaf = leaf_ids_by_replay(codes, sf, tr, split_leaf, nl - 1,
                                   max_nodes=max_nodes)
-        return score.at[tc].add(lv[leaf]), None
+        return score.at[tc].add(exact_table_lookup(lv, leaf)), None
 
     init = jnp.zeros((num_class, N), jnp.float32)
     score, _ = jax.lax.scan(
@@ -147,4 +149,5 @@ def add_tree_score(bins: jax.Array, score: jax.Array,
                                      num_nodes=num_leaves - 1)
     leaf = leaf_ids_by_replay(bins, split_feature, threshold_bin, split_leaf,
                               num_leaves - 1, max_nodes=max_nodes)
-    return score + leaf_value[leaf].astype(score.dtype)
+    return score + exact_table_lookup(
+        leaf_value.astype(jnp.float32), leaf).astype(score.dtype)
