@@ -48,6 +48,8 @@ class GBDT:
         self._saved_model_size = -1
         self._model_file = None
         self._learner_factory: Optional[Callable] = None
+        self._mp = False            # multi-process data-parallel mode
+        self._row_valid = None
 
     # ------------------------------------------------------------------ init
 
@@ -69,31 +71,78 @@ class GBDT:
         self._learner = learner or _serial_learner
 
         N = train_data.num_data
-        self.num_data = N
-        self.bins_device = jnp.asarray(train_data.bins)
-        self.num_bins_device = jnp.asarray(train_data.num_bins)
         self.num_bins_max = int(train_data.num_bins.max())
         self.num_features = train_data.num_features
         # [F, B] bin→upper-bound table for vectorized threshold conversion
         self._bin_upper_table = train_data.bin_upper_bounds_matrix()
 
-        # score state [num_class, N] (ScoreUpdater init from init_score,
-        # score_updater.hpp:27-33)
-        init_score = train_data.metadata.init_score
-        if init_score is not None:
-            score0 = np.tile(np.asarray(init_score, np.float32), (self.num_class, 1))
+        # multi-process data parallelism (the reference's N-machine mode,
+        # dataset.cpp:172-216): each process holds a row shard; lift every
+        # row-aligned array to a global mesh-sharded jax.Array so the
+        # shard_map programs span the whole distributed job.
+        self._mp = (jax.process_count() > 1 and learner is not None
+                    and type(learner).__name__ == "DataParallelLearner")
+        if self._mp:
+            from ..parallel import mesh as _pmesh
+            # same mesh the learner's shard_map programs will use
+            mesh = _pmesh.get_mesh(
+                device_type=getattr(getattr(learner, "config", None),
+                                    "device_type", "") or "")
+            max_n, _ = _pmesh.global_row_layout(N)
+            self._mp_make_global = functools.partial(
+                _pmesh.make_global_rows, max_n=max_n, mesh=mesh)
+            if (boosting_config.bagging_fraction < 1.0
+                    and boosting_config.bagging_freq > 0):
+                log.fatal("bagging is not supported with multi-process "
+                          "data-parallel training yet")
+            if objective is not None and not hasattr(objective, "globalize"):
+                log.fatal("objective does not support multi-process "
+                          "data-parallel training (no row-aligned state "
+                          "globalization)")
+            if training_metrics:
+                log.fatal("metric evaluation is not supported with "
+                          "multi-process data-parallel training yet")
+            self.num_data = max_n * jax.process_count()
+            self.bins_device = self._mp_make_global(train_data.bins,
+                                                    row_axis=1)
+            # replicated small arrays stay host-side (every process passes
+            # identical values into the jitted programs)
+            self.num_bins_device = np.asarray(train_data.num_bins)
+            valid = np.zeros(max_n, bool)
+            valid[:N] = True
+            self._row_valid = self._mp_make_global(valid)
+            init_score = train_data.metadata.init_score
+            score0 = (np.tile(np.asarray(init_score, np.float32),
+                              (self.num_class, 1))
+                      if init_score is not None
+                      else np.zeros((self.num_class, N), np.float32))
+            self.score = self._mp_make_global(score0, row_axis=1)
         else:
-            score0 = np.zeros((self.num_class, N), np.float32)
-        self.score = jnp.asarray(score0)
+            self.num_data = N
+            self.bins_device = jnp.asarray(train_data.bins)
+            self.num_bins_device = jnp.asarray(train_data.num_bins)
+            self._row_valid = None
+            init_score = train_data.metadata.init_score
+            if init_score is not None:
+                score0 = np.tile(np.asarray(init_score, np.float32),
+                                 (self.num_class, 1))
+            else:
+                score0 = np.zeros((self.num_class, N), np.float32)
+            self.score = jnp.asarray(score0)
 
         # bagging state (gbdt.cpp:77-88)
         self._bag_rng = np.random.RandomState(boosting_config.bagging_seed)
         self._use_bagging = (boosting_config.bagging_fraction < 1.0
                              and boosting_config.bagging_freq > 0)
-        self._bag_mask = np.ones(N, dtype=bool)
-        # device-side mask caches: uploads pay full link latency, so only
-        # re-upload when the host-side mask actually changes
-        self._bag_mask_device = jnp.asarray(self._bag_mask)
+        if self._mp:
+            # padded phantom rows must never enter histograms/root stats
+            self._bag_mask = None
+            self._bag_mask_device = self._row_valid
+        else:
+            self._bag_mask = np.ones(N, dtype=bool)
+            # device-side mask caches: uploads pay full link latency, so
+            # only re-upload when the host-side mask actually changes
+            self._bag_mask_device = jnp.asarray(self._bag_mask)
         self._feat_mask_device = {}
         # per-class feature-fraction RNGs, same seed each
         # (serial_tree_learner.cpp:159-167; one learner per class)
@@ -102,11 +151,17 @@ class GBDT:
 
         if objective is not None:
             objective.init(train_data.metadata, N)
+            if self._mp:
+                # lift row-aligned objective state to global sharded arrays
+                objective.globalize(self._mp_make_global)
         for metric in self.training_metrics:
             metric.init("training", train_data.metadata, N)
 
     def add_valid_dataset(self, valid_data, valid_metrics, name=None) -> None:
         """GBDT::AddDataset (gbdt.cpp:92-105)."""
+        if self._mp:
+            log.fatal("validation datasets are not supported with "
+                      "multi-process data-parallel training yet")
         idx = len(self.valid_datasets)
         name = name or f"valid_{idx + 1}"
         entry = {
@@ -444,23 +499,27 @@ class GBDT:
         score_before = self.score
         valid_before = [e["score"] for e in self.valid_datasets]
 
+        # multi-process runs keep replicated inputs host-side (every process
+        # passes identical values; a committed local jnp array would clash
+        # with the global-mesh program)
+        _arr = np.asarray if self._mp else jnp.asarray
         if has_bag:
             rms = np.zeros((k, C, N + pad), dtype=bool)
             for i in range(k):
                 for cls in range(C):
                     self._draw_bag_mask(self.iter + i)
                     rms[i, cls, :N] = self._bag_mask
-            row_masks = jnp.asarray(rms)
+            row_masks = _arr(rms)
         else:
-            row_masks = jnp.zeros((k, 1), jnp.bool_)   # scan driver only
+            row_masks = _arr(np.zeros((k, 1), bool))   # scan driver only
         if has_ff:
             fms = np.empty((k, C, F), dtype=bool)
             for i in range(k):
                 for cls in range(C):
                     fms[i, cls] = self._feature_sample(cls)
-            feat_masks = jnp.asarray(fms)
+            feat_masks = _arr(fms)
         else:
-            feat_masks = jnp.zeros((k, 1), jnp.bool_)
+            feat_masks = _arr(np.zeros((k, 1), bool))
 
         if dp:
             # pad rows to the shard grid once per booster; padded rows are
@@ -475,7 +534,13 @@ class GBDT:
                                        * (l.ndim - 1))
                                if pad and getattr(l, "ndim", 0) >= 1 else l),
                     obj_params)
-                valid_rows = jnp.arange(N + pad) < N
+                if self._mp:
+                    # multi-process: per-process padding is interleaved
+                    # (each rank's block ends with phantom rows), and
+                    # num_data is already device-aligned (pad == 0)
+                    valid_rows = self._row_valid
+                else:
+                    valid_rows = jnp.arange(N + pad) < N
                 cache = (num_shards, bins_p, obj_p, valid_rows)
                 self._dp_chunk_inputs = cache
             _, bins_p, obj_p, valid_rows = cache

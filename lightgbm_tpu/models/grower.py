@@ -97,7 +97,7 @@ def grow_tree_impl(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                    min_data_in_leaf: int, min_sum_hessian_in_leaf: float,
                    max_depth: int = -1, hist_backend: str = "matmul",
                    hist_chunk: int = 16384, compute_dtype=jnp.float32,
-                   hist_reduce=None,
+                   hist_reduce=None, hist_axis=None,
                    split_finder=None, partition_bins=None,
                    stat_reduce=None) -> TreeArrays:
     """Core grower (not jitted; callers wrap it).
@@ -137,8 +137,12 @@ def grow_tree_impl(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     def hist_of(mask):
         hist = build_histogram(bins, grad, hess, mask, B,
                                backend=hist_backend, chunk=hist_chunk,
-                               compute_dtype=compute_dtype)
-        if hist_reduce is not None:
+                               compute_dtype=compute_dtype,
+                               axis_name=hist_axis)
+        # the quantized path reduces its INT accumulators internally over
+        # hist_axis (bit-exactness; ops/hist_pallas.quantize_values)
+        if hist_reduce is not None and not (
+                compute_dtype == "int8" and hist_axis is not None):
             hist = hist_reduce(hist)
         return hist
 
@@ -154,16 +158,25 @@ def grow_tree_impl(bins: jax.Array, grad: jax.Array, hess: jax.Array,
 
     # ---- root init (BeforeTrain, serial_tree_learner.cpp:155-236)
     root_hist = hist_of(row_mask)
-    # root sums come from the gradient vectors, not from any one feature's
-    # histogram: per-feature f32 bin-order rounding would make the totals
-    # shard-dependent under feature-parallel ownership (the reference
-    # likewise computes root sums once from gradients,
-    # serial_tree_learner.cpp:178-198 / data_parallel root-sum allreduce)
-    maskf = row_mask.astype(f32)
-    root_stats = jnp.stack([jnp.sum(grad * maskf), jnp.sum(hess * maskf),
-                            jnp.sum(maskf)])
-    if stat_reduce is not None:
-        root_stats = stat_reduce(root_stats)
+    if compute_dtype == "int8":
+        # quantized mode: derive root stats from the histogram — the int
+        # accumulators are bit-identical across serial/data-parallel (see
+        # grower_depthwise.py root-stat note), and any feature's bins sum
+        # to the same exact quantized totals, so this also holds under
+        # feature-parallel ownership slices
+        root_stats = jnp.sum(root_hist[0], axis=0)
+    else:
+        # root sums come from the gradient vectors, not from any one
+        # feature's histogram: per-feature f32 bin-order rounding would
+        # make the totals shard-dependent under feature-parallel ownership
+        # (the reference likewise computes root sums once from gradients,
+        # serial_tree_learner.cpp:178-198 / data_parallel root-sum
+        # allreduce)
+        maskf = row_mask.astype(f32)
+        root_stats = jnp.stack([jnp.sum(grad * maskf),
+                                jnp.sum(hess * maskf), jnp.sum(maskf)])
+        if stat_reduce is not None:
+            root_stats = stat_reduce(root_stats)
     root_g, root_h, root_c = root_stats[0], root_stats[1], root_stats[2]
     root_best = best_of(root_hist, root_g, root_h, root_c,
                         jnp.asarray(1, jnp.int32))

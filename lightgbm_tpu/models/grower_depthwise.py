@@ -42,7 +42,10 @@ from ..ops.histogram import histogram_leafbatch
 from ..ops.split import find_best_split
 from .grower import TreeArrays
 
-BIG = jnp.int32(1 << 28)  # out-of-bounds scatter index → mode="drop"
+# out-of-bounds scatter index → mode="drop".  A plain int, NOT jnp.int32:
+# creating a jax array at import time would initialize the XLA backend
+# before jax.distributed.initialize can run (multi-process bootstrap).
+BIG = 1 << 28
 
 
 def num_levels(num_leaves: int, max_depth: int = -1) -> int:
@@ -62,7 +65,7 @@ def grow_tree_depthwise(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                         min_sum_hessian_in_leaf: float, max_depth: int = -1,
                         hist_chunk: int = 65536, hist_reduce=None,
                         stat_reduce=None, split_finder=None,
-                        partition_bins=None,
+                        partition_bins=None, hist_axis=None,
                         compute_dtype=jnp.float32) -> TreeArrays:
     """Grow one depth-wise tree.  Output contract == grow_tree_impl's
     TreeArrays (models/grower.py), so boosting/serialization/prediction are
@@ -92,8 +95,13 @@ def grow_tree_depthwise(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     def batch_hist_rows(b, g, h, col_id, col_ok, C):
         out = histogram_leafbatch(b, g, h, col_id, col_ok, C, B,
                                   chunk=hist_chunk,
-                                  compute_dtype=compute_dtype)
-        if hist_reduce is not None:
+                                  compute_dtype=compute_dtype,
+                                  axis_name=hist_axis)
+        # the quantized path reduces its INT accumulators internally over
+        # hist_axis (bit-exactness); applying hist_reduce again would
+        # double-count
+        if hist_reduce is not None and not (
+                compute_dtype == "int8" and hist_axis is not None):
             out = hist_reduce(out)
         return out
 
@@ -106,10 +114,21 @@ def grow_tree_depthwise(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         partition_bins = bins
 
     # ---- root (BeforeTrain: serial_tree_learner.cpp:155-236)
-    root_stats = jnp.stack([jnp.sum(grad * maskf), jnp.sum(hess * maskf),
-                            jnp.sum(maskf)])
-    if stat_reduce is not None:
-        root_stats = stat_reduce(root_stats)
+    hists = batch_hist(jnp.zeros((N,), i32), row_mask, 1)  # [1, F, B, 3]
+    if compute_dtype == "int8":
+        # derive root stats from the root histogram: the quantized hist is
+        # bit-identical across serial / data-parallel / multi-process (the
+        # scale is pmax-synced and int32 sums are order-free), so this
+        # makes the WHOLE tree's stat chain reduction-order-free — a row
+        # psum here would differ from a serial row sum by ulps and flip
+        # near-tie splits between serial and distributed runs.  (Also keeps
+        # parent == left + right exactly in quantized space.)
+        root_stats = jnp.sum(hists[0, 0], axis=0)          # [3]
+    else:
+        root_stats = jnp.stack([jnp.sum(grad * maskf),
+                                jnp.sum(hess * maskf), jnp.sum(maskf)])
+        if stat_reduce is not None:
+            root_stats = stat_reduce(root_stats)
 
     # per-slot level state (slot s at level d holds one candidate leaf)
     alive = jnp.ones((1,), bool)
@@ -118,7 +137,6 @@ def grow_tree_depthwise(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     slot_g = root_stats[0][None]
     slot_h = root_stats[1][None]
     slot_c = root_stats[2][None]
-    hists = batch_hist(jnp.zeros((N,), i32), row_mask, 1)  # [1, F, B, 3]
 
     slot_id = jnp.zeros((N,), i32)          # row → level-local slot
     out_leaf = jnp.zeros((N,), i32)         # row → output leaf index
@@ -302,4 +320,4 @@ grow_tree_depthwise_jit = jax.jit(
     grow_tree_depthwise,
     static_argnames=("num_leaves", "num_bins_max", "min_data_in_leaf",
                      "min_sum_hessian_in_leaf", "max_depth", "hist_chunk",
-                     "compute_dtype"))
+                     "compute_dtype", "hist_axis"))

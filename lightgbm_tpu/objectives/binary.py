@@ -57,6 +57,15 @@ class BinaryLogloss:
                 "label_weight": self.label_weight,
                 "weights": self.weights}
 
+    def globalize(self, make_global) -> None:
+        """Multi-process: lift row-aligned state to global sharded arrays.
+        Padded rows get label_sign=0 -> zero response/hessian, so they
+        cannot contribute even without masking."""
+        self.label_sign = make_global(self.label_sign)
+        self.label_weight = make_global(self.label_weight)
+        if self.weights is not None:
+            self.weights = make_global(self.weights)
+
     @property
     def sigmoid(self) -> float:
         return self._sigmoid

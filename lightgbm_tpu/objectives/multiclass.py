@@ -38,6 +38,13 @@ class MulticlassLogloss:
     def chunk_params(self):
         return {"onehot": self.onehot, "weights": self.weights}
 
+    def globalize(self, make_global) -> None:
+        """Multi-process: lift row-aligned state to global sharded arrays."""
+        self.label_int = make_global(self.label_int)
+        self.onehot = make_global(self.onehot)
+        if self.weights is not None:
+            self.weights = make_global(self.weights)
+
     @property
     def sigmoid(self) -> float:
         return -1.0

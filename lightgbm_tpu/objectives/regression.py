@@ -29,6 +29,13 @@ class RegressionL2Loss:
     def chunk_params(self):
         return {"label": self.label, "weights": self.weights}
 
+    def globalize(self, make_global) -> None:
+        """Multi-process: lift row-aligned state to global sharded arrays
+        (the data-parallel chunk shards them over the mesh data axis)."""
+        self.label = make_global(self.label)
+        if self.weights is not None:
+            self.weights = make_global(self.weights)
+
     @property
     def sigmoid(self) -> float:
         return -1.0

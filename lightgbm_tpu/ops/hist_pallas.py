@@ -115,16 +115,32 @@ def hist_pallas_raw(bins, packed, *, B: int, chunk: int = 2048,
     return out.astype(jnp.int32)
 
 
-def quantize_values(grad, hess, col_ok, rng_bits=None):
+def quantize_values(grad, hess, col_ok, rng_bits=None, axis_name=None):
     """int8 quantization of grad/hess with a per-pass global scale.
 
     Round-to-nearest by default; unbiased stochastic rounding (floor(y+u))
     when ``rng_bits`` [2, N] uint32 is given.  Returns (vals [3, N] int8
     lane-major, scale [3] f32) — the count row is exact by construction.
+
+    ``axis_name``: under shard_map, pmax the scale over the data axis so
+    every shard quantizes identically — int32 accumulation is then
+    order-free, making data-parallel histograms BIT-identical to serial
+    (the quantized analog of the reference's every-worker-identical-split
+    invariant, data_parallel_tree_learner.cpp:237-243).
     """
     okf = col_ok.astype(jnp.float32)
-    gs = jnp.maximum(jnp.max(jnp.abs(grad)), 1e-30) / 127.0
-    hs = jnp.maximum(jnp.max(jnp.abs(hess)), 1e-30) / 127.0
+    # the scale must come from PARTICIPATING rows only: multi-process
+    # phantom padding rows can carry arbitrary score-residual gradients
+    # (their scores still accumulate leaf values) and would inflate the
+    # scale, collapsing quantization resolution and breaking the
+    # serial == distributed bit-identity
+    ag = jnp.max(jnp.abs(grad) * okf)
+    ah = jnp.max(jnp.abs(hess) * okf)
+    if axis_name is not None:
+        ag = jax.lax.pmax(ag, axis_name)
+        ah = jax.lax.pmax(ah, axis_name)
+    gs = jnp.maximum(ag, 1e-30) / 127.0
+    hs = jnp.maximum(ah, 1e-30) / 127.0
 
     def quant(x, s, bits):
         y = x / s
@@ -158,7 +174,8 @@ def _grouped(fn, bins, grad, hess, col_id, col_ok, num_cols, B, **kw):
 
 def hist_pallas_leafbatch(bins, grad, hess, col_id, col_ok, num_cols: int,
                           num_bins_max: int, *, chunk: int = 2048,
-                          dtype: str = "int8", rng_bits=None):
+                          dtype: str = "int8", rng_bits=None,
+                          axis_name=None):
     """Drop-in histogram_leafbatch equivalent on the Pallas kernel.
 
     ``bins`` is the usual [F, N] matrix (int8 or uint8).  The int32
@@ -169,7 +186,7 @@ def hist_pallas_leafbatch(bins, grad, hess, col_id, col_ok, num_cols: int,
     if num_cols <= 64:
         return _hist_pallas_one(bins, grad, hess, col_id, col_ok, num_cols,
                                 num_bins_max, chunk=chunk, dtype=dtype,
-                                rng_bits=rng_bits)
+                                rng_bits=rng_bits, axis_name=axis_name)
     n_groups = -(-num_cols // 64)
     width = -(-num_cols // n_groups)
     parts = []
@@ -178,15 +195,17 @@ def hist_pallas_leafbatch(bins, grad, hess, col_id, col_ok, num_cols: int,
         ok = col_ok & (col_id >= base) & (col_id < base + k)
         parts.append(_hist_pallas_one(
             bins, grad, hess, col_id - base, ok, k, num_bins_max,
-            chunk=chunk, dtype=dtype, rng_bits=rng_bits))
+            chunk=chunk, dtype=dtype, rng_bits=rng_bits,
+            axis_name=axis_name))
     return jnp.concatenate(parts, axis=0)
 
 
 def _hist_pallas_one(bins, grad, hess, col_id, col_ok, num_cols, B, *,
-                     chunk, dtype, rng_bits):
+                     chunk, dtype, rng_bits, axis_name=None):
     F, N = bins.shape
     lanes = LANES if num_cols <= 42 else 192
-    vals, scale = quantize_values(grad, hess, col_ok, rng_bits)
+    vals, scale = quantize_values(grad, hess, col_ok, rng_bits,
+                                  axis_name=axis_name)
     cid8 = jnp.where(col_ok, col_id, -1).astype(jnp.int8)
     packed = jnp.concatenate([vals, cid8[None, :]], axis=0)  # [4, N] int8
 
@@ -197,27 +216,35 @@ def _hist_pallas_one(bins, grad, hess, col_id, col_ok, num_cols, B, *,
     acc = hist_pallas_raw(bins.astype(jnp.int8), packed, B=B,
                           chunk=chunk, dtype=dtype,
                           lanes=lanes)                       # [F, B, lanes]
+    if axis_name is not None:
+        # reduce the INT accumulators across shards: dequantize-then-psum
+        # would round (sum of 8 f32 products != int-sum x scale) and break
+        # the bit-identical serial == data-parallel invariant
+        acc = jax.lax.psum(acc, axis_name)
     hist = acc[:, :, :num_cols * 3].astype(jnp.float32)
     hist = hist.reshape(F, B, num_cols, 3).transpose(2, 0, 1, 3)
     return hist * scale
 
 
 def hist_quant_xla(bins, grad, hess, col_id, col_ok, num_cols: int,
-                   num_bins_max: int, *, chunk: int = 65536, rng_bits=None):
+                   num_bins_max: int, *, chunk: int = 65536, rng_bits=None,
+                   axis_name=None):
     """XLA reference of the SAME quantized-gradient math as the Pallas int8
     kernel (bit-identical output) — the CPU-testable oracle and the
     fallback on non-TPU backends."""
     return _grouped(_hist_quant_xla_one, bins, grad, hess, col_id, col_ok,
-                    num_cols, num_bins_max, chunk=chunk, rng_bits=rng_bits)
+                    num_cols, num_bins_max, chunk=chunk, rng_bits=rng_bits,
+                    axis_name=axis_name)
 
 
 def _hist_quant_xla_one(bins, grad, hess, col_id, col_ok, num_cols, B, *,
-                        chunk, rng_bits):
+                        chunk, rng_bits, axis_name=None):
     F, N = bins.shape
     C = num_cols
     # don't pad a small input up to a full default chunk
     chunk = min(chunk, max(256, -(-N // 256) * 256))
-    vals, scale = quantize_values(grad, hess, col_ok, rng_bits)
+    vals, scale = quantize_values(grad, hess, col_ok, rng_bits,
+                                  axis_name=axis_name)
     cid = jnp.where(col_ok, col_id, -1).astype(jnp.int32)
     pad = (-N) % chunk
     if pad:
@@ -242,5 +269,7 @@ def _hist_quant_xla_one(bins, grad, hess, col_id, col_ok, num_cols, B, *,
 
     init = jnp.zeros((F, B, C * 3), jnp.int32)
     hist, _ = jax.lax.scan(body, init, (bins_c, vals_c, cid_c))
+    if axis_name is not None:
+        hist = jax.lax.psum(hist, axis_name)   # int-domain cross-shard sum
     hist = hist.reshape(F, B, C, 3).transpose(2, 0, 1, 3).astype(jnp.float32)
     return hist * scale

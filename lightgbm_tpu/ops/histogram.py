@@ -104,7 +104,8 @@ def _onehot_chunk(bins_chunk: jax.Array, vals_chunk: jax.Array, B: int,
 def histogram_leafbatch(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                         col_id: jax.Array, col_ok: jax.Array, num_cols: int,
                         num_bins_max: int, chunk: int = 65536,
-                        compute_dtype=jnp.bfloat16) -> jax.Array:
+                        compute_dtype=jnp.bfloat16,
+                        axis_name=None) -> jax.Array:
     """Build histograms for MANY leaves in ONE matmul pass.
 
     The single-leaf one-hot matmul starves the MXU: the value operand has
@@ -133,9 +134,11 @@ def histogram_leafbatch(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         from .hist_pallas import hist_pallas_leafbatch, hist_quant_xla
         if _jax.default_backend() == "tpu":
             return hist_pallas_leafbatch(bins, grad, hess, col_id, col_ok,
-                                         num_cols, num_bins_max)
+                                         num_cols, num_bins_max,
+                                         axis_name=axis_name)
         return hist_quant_xla(bins, grad, hess, col_id, col_ok, num_cols,
-                              num_bins_max, chunk=chunk)
+                              num_bins_max, chunk=chunk,
+                              axis_name=axis_name)
     F, N = bins.shape
     B = num_bins_max
     # cap the pass at ONE 128-lane tile of the value operand (42 histogram
@@ -225,7 +228,7 @@ def histogram_leafbatch_segsum(bins, grad, hess, col_id, col_ok,
 
 def hist_quant_segsum(bins, grad, hess, col_id, col_ok, num_cols: int,
                       num_bins_max: int, chunk: int = 0, rng_bits=None,
-                      compute_dtype=None):
+                      compute_dtype=None, axis_name=None):
     """Scatter-add variant of the quantized-gradient histogram — exact
     int32 accumulation, so it is bit-identical to hist_pallas/hist_quant_xla
     (ops/hist_pallas.py) at any summation order; the CPU-fast oracle for
@@ -234,7 +237,8 @@ def hist_quant_segsum(bins, grad, hess, col_id, col_ok, num_cols: int,
     F, N = bins.shape
     B = num_bins_max
     C = num_cols
-    vals, scale = quantize_values(grad, hess, col_ok, rng_bits)  # [3, N] i8
+    vals, scale = quantize_values(grad, hess, col_ok, rng_bits,
+                                  axis_name=axis_name)      # [3, N] i8
     cid = jnp.where(col_ok, col_id, C).astype(jnp.int32)
     ids = (cid[None, :] * F + jnp.arange(F, dtype=jnp.int32)[:, None]) * B \
         + bins.astype(jnp.int32)
@@ -242,6 +246,8 @@ def hist_quant_segsum(bins, grad, hess, col_id, col_ok, num_cols: int,
                          (F, N, 3)).reshape(-1, 3)
     hist = jax.ops.segment_sum(v, ids.reshape(-1),
                                num_segments=(C + 1) * F * B)
+    if axis_name is not None:
+        hist = jax.lax.psum(hist, axis_name)   # int-domain cross-shard sum
     hist = hist.reshape(C + 1, F, B, 3)[:C].astype(jnp.float32)
     return hist * scale
 
@@ -262,14 +268,14 @@ def histogram_segsum(bins: jax.Array, grad: jax.Array, hess: jax.Array,
 
 def build_histogram(bins, grad, hess, mask, num_bins_max, *,
                     backend: str = "matmul", chunk: int = 16384,
-                    compute_dtype=jnp.float32) -> jax.Array:
+                    compute_dtype=jnp.float32, axis_name=None) -> jax.Array:
     if compute_dtype == "int8":
         # single-leaf quantized pass == leaf-batched with one column
         N = bins.shape[1]
         cid = jnp.zeros((N,), jnp.int32)
         out = histogram_leafbatch(bins, grad, hess, cid, mask, 1,
                                   num_bins_max, chunk=chunk,
-                                  compute_dtype="int8")
+                                  compute_dtype="int8", axis_name=axis_name)
         return out[0]
     if backend == "matmul":
         return histogram_matmul(bins, grad, hess, mask, num_bins_max,

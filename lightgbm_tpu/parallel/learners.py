@@ -148,6 +148,7 @@ class DataParallelLearner(_ParallelLearnerBase):
                     *a,
                     hist_reduce=lambda h: jax.lax.psum(h, DATA_AXIS),
                     stat_reduce=lambda s: jax.lax.psum(s, DATA_AXIS),
+                    hist_axis=DATA_AXIS,
                     **kwargs),
                 has_bag=has_bag, has_ff=has_ff, bins=bins,
                 num_bins=num_bins, base_mask=valid_rows)
@@ -198,6 +199,7 @@ class DataParallelLearner(_ParallelLearnerBase):
                     bins_s, grad_s, hess_s, mask_s, fmask, nbins,
                     hist_reduce=lambda h: jax.lax.psum(h, DATA_AXIS),
                     stat_reduce=lambda s: jax.lax.psum(s, DATA_AXIS),
+                    hist_axis=DATA_AXIS,
                     **kwargs)
 
             self._jitted = jax.jit(shard_map(

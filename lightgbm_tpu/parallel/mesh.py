@@ -26,14 +26,21 @@ def init_distributed(config=None) -> None:
     """Multi-host bootstrap (linkers_socket.cpp equivalent).
 
     Uses jax.distributed when coordinator env vars are present; single-host
-    multi-device needs no bootstrap.
+    multi-device needs no bootstrap.  Must run before anything touches the
+    XLA backend — so the already-initialized check reads the distributed
+    client state directly instead of jax.process_count() (which would
+    itself initialize the backend and make initialize() impossible).
     """
     coordinator = os.environ.get("LGBM_TPU_COORDINATOR")
-    if coordinator and jax.process_count() == 1:
-        jax.distributed.initialize(
-            coordinator_address=coordinator,
-            num_processes=int(os.environ.get("LGBM_TPU_NUM_PROCS", "1")),
-            process_id=int(os.environ.get("LGBM_TPU_PROC_ID", "0")))
+    if not coordinator:
+        return
+    from jax._src import distributed as _distributed
+    if _distributed.global_state.client is not None:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=int(os.environ.get("LGBM_TPU_NUM_PROCS", "1")),
+        process_id=int(os.environ.get("LGBM_TPU_PROC_ID", "0")))
 
 
 def get_mesh(num_machines: Optional[int] = None,
@@ -43,8 +50,15 @@ def get_mesh(num_machines: Optional[int] = None,
 
     ``device_type`` (config.py device_type: "cpu"/"tpu"/"gpu") selects the
     backend to draw mesh slots from in mixed-backend processes; empty means
-    the default platform."""
+    the default platform.
+
+    Multi-process runs use EVERY device of the distributed job (a
+    "machine" in the reference maps to a process; each contributes all its
+    local devices as mesh slots — jax.devices() is globally ordered by
+    process index, which make_global_rows relies on)."""
     devices = jax.devices(device_type) if device_type else jax.devices()
+    if jax.process_count() > 1:
+        return Mesh(np.array(devices), (axis_name,))
     if num_machines is None or num_machines <= 0:
         num_machines = len(devices)
     if num_machines > len(devices):
@@ -53,9 +67,7 @@ def get_mesh(num_machines: Optional[int] = None,
             "world size to match (linkers_socket.cpp:106-109 behavior)"
             % (num_machines, len(devices)))
         num_machines = len(devices)
-    mesh = Mesh(np.array(devices[:num_machines]), (axis_name,))
-    _mesh = mesh
-    return mesh
+    return Mesh(np.array(devices[:num_machines]), (axis_name,))
 
 
 def get_rank() -> int:
@@ -65,6 +77,49 @@ def get_rank() -> int:
 
 def get_num_machines() -> int:
     return jax.process_count()
+
+
+def global_row_layout(n_local: int):
+    """Agree on a per-process padded row-block size for multi-host arrays.
+
+    The reference's data-parallel mode gives each PROCESS an uneven random
+    row shard (dataset.cpp:172-216); jax sharded arrays need equal
+    per-device blocks, so every process pads its shard to the global max
+    (rounded up to its local device count).  Returns (max_n, counts) with
+    counts[p] = process p's true row count."""
+    from jax.experimental import multihost_utils
+    counts = multihost_utils.process_allgather(np.asarray(n_local))
+    counts = np.atleast_1d(np.asarray(counts)).reshape(-1)
+    d_local = jax.local_device_count()
+    max_n = int(counts.max())
+    max_n = -(-max_n // d_local) * d_local
+    return max_n, counts
+
+
+def make_global_rows(local, max_n: int, mesh: Mesh, row_axis: int = 0,
+                     axis_name: str = DATA_AXIS):
+    """One process's row shard -> the global row-sharded jax.Array.
+
+    Pads ``local`` to ``max_n`` rows along ``row_axis`` and assembles the
+    [P * max_n, ...] global array via
+    ``jax.make_array_from_process_local_data`` — the glue between host
+    shards and the shard_map programs (rows land on the owning process's
+    devices; no cross-host transfer)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    local = np.asarray(local)
+    pad = max_n - local.shape[row_axis]
+    assert pad >= 0
+    if pad:
+        widths = [(0, 0)] * local.ndim
+        widths[row_axis] = (0, pad)
+        local = np.pad(local, widths)
+    spec = [None] * local.ndim
+    spec[row_axis] = axis_name
+    sharding = NamedSharding(mesh, PartitionSpec(*spec))
+    global_shape = list(local.shape)
+    global_shape[row_axis] = max_n * jax.process_count()
+    return jax.make_array_from_process_local_data(
+        sharding, local, tuple(global_shape))
 
 
 def sync_up_by_min(value):
