@@ -83,6 +83,17 @@ def main() -> int:
                              "tests/test_auc_parity.py); float32 is the "
                              "reference-exact mode")
     args = parser.parse_args()
+    if (args.hist_dtype != "int8" and args.rows > 4_000_000
+            and args.grow_policy == "depthwise"):
+        # one fused dispatch of --iters f32 iterations at this scale would
+        # cross the environment's ~60 s per-dispatch execution watchdog
+        # (BASELINE.md); clamp to a safe chunk length
+        safe = max(1, int(40.0 / (args.rows * 1.35e-7)))
+        if args.iters > safe:
+            print(f"clamping --iters {args.iters} -> {safe} "
+                  f"(f32 dispatch watchdog, see BASELINE.md)",
+                  file=sys.stderr)
+            args.iters = safe
 
     import jax
     import lightgbm_tpu as lgb
@@ -116,15 +127,29 @@ def main() -> int:
     objective = create_objective(cfg.objective_type, cfg.objective_config)
     booster.init(cfg.boosting_config, ds, objective)
 
-    # warmup: one chunk of the same size compiles + caches the fused
-    # k-iteration program (models from warmup iterations are kept; they make
-    # the timed chunks realistic mid-training iterations)
-    booster.train_chunk(args.iters)
-    jax.block_until_ready(booster.score)
+    # leaf-wise runs per-iteration: a fused leaf-wise chunk is one dispatch
+    # of k x 254 histogram passes, which is both slower than per-iteration
+    # dispatch AND crosses the environment's ~60 s per-dispatch execution
+    # watchdog at production shapes (BASELINE.md)
+    def run_chunks():
+        if args.grow_policy == "leafwise":
+            for i in range(args.iters):
+                if booster.train_one_iter(is_eval=False):
+                    raise SystemExit(
+                        f"training stopped after {i} iterations (no "
+                        f"splittable leaf) — bench numbers would be "
+                        f"meaningless; use more rows or fewer constraints")
+        else:
+            booster.train_chunk(args.iters)
+        jax.block_until_ready(booster.score)
+
+    # warmup: one round of the same shape compiles + caches the programs
+    # (models from warmup iterations are kept; they make the timed chunks
+    # realistic mid-training iterations)
+    run_chunks()
 
     start = time.time()
-    booster.train_chunk(args.iters)
-    jax.block_until_ready(booster.score)
+    run_chunks()
     elapsed = time.time() - start
 
     iters_per_sec = args.iters / elapsed

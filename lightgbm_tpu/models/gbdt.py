@@ -394,13 +394,20 @@ class GBDT:
         return False
 
     def chunkable_for(self, is_eval: bool) -> bool:
-        """run_training's chunking decision: chunk_supported AND the
-        depthwise grower.  Wrapping the leaf-wise grower's 254-split
-        fori_loop in the k-iteration scan crashes the TPU runtime at
-        production shapes (observed: 500k rows x 255 leaves x k>=4 kills
-        the worker; k<=2 survives), so run_training keeps leaf-wise on the
-        known-good per-iteration path; direct train_chunk calls remain
-        available for leaf-wise (used by CPU tests)."""
+        """run_training's chunking decision: chunk_supported AND a
+        chunk-safe grower/histogram combination.
+
+        The round-1 "leaf-wise chunk crash" was root-caused to this
+        environment's ~60 s per-dispatch execution watchdog (BASELINE.md;
+        a plain matmul fori_loop reproduces it — not a grower bug): a
+        fused leaf-wise chunk is ONE dispatch of k x 254 histogram passes
+        and crosses the cap at production shapes (f32: k=3 x 500k; int8:
+        k~22 x 1M).  Fused leaf-wise is also measured SLOWER than the
+        per-iteration leaf-wise path (int8 in-scan 2.95 s/iter at 1M vs
+        0.63 s/iter per-iteration f32 — per-pass quantization overhead
+        dominates the C=1 passes), so leaf-wise stays per-iteration on
+        every count.  Direct train_chunk calls remain available for
+        leaf-wise on CPU (used by tests)."""
         return (self.chunk_supported(is_eval)
                 and self.tree_config.grow_policy == "depthwise")
 
