@@ -106,18 +106,33 @@ class Application:
         log.info("Finish loading data, use %f seconds" % (time.time() - start))
 
     def train(self) -> None:
-        """Application::Train (application.cpp:239-257)."""
+        """Application::Train (application.cpp:239-257).
+
+        ``profile_dir=<dir>`` (SURVEY §5.1) wraps the loop in a
+        jax.profiler trace — the device-level phase breakdown the
+        reference's wall-clock logs cannot give."""
         log.info("Start train ...")
         is_eval = bool(self.train_metrics) or any(
             m for _, m, _ in self.valid_datas)
         start = time.time()
-        self.boosting.run_training(
-            self.config.boosting_config.num_iterations, is_eval,
-            save_fn=lambda: self.boosting.save_model_to_file(
-                False, self.config.io_config.output_model),
-            progress_fn=lambda it: log.info(
-                "%f seconds elapsed, finished %d iteration"
-                % (time.time() - start, it)))
+
+        def _run():
+            self.boosting.run_training(
+                self.config.boosting_config.num_iterations, is_eval,
+                save_fn=lambda: self.boosting.save_model_to_file(
+                    False, self.config.io_config.output_model),
+                progress_fn=lambda it: log.info(
+                    "%f seconds elapsed, finished %d iteration"
+                    % (time.time() - start, it)))
+
+        if self.config.io_config.profile_dir:
+            import jax
+            with jax.profiler.trace(self.config.io_config.profile_dir):
+                _run()
+            log.info("Profiler trace written to %s"
+                     % self.config.io_config.profile_dir)
+        else:
+            _run()
         self.boosting.save_model_to_file(
             True, self.config.io_config.output_model)
         log.info("Finished train")

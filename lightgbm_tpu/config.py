@@ -134,6 +134,9 @@ class IOConfig:
     data_filename: str = ""
     valid_data_filenames: List[str] = dataclasses.field(default_factory=list)
     output_model: str = "LightGBM_model.txt"
+    # TPU extension (SURVEY §5.1): write a jax.profiler trace of the
+    # training loop to this directory (view with tensorboard / xprof)
+    profile_dir: str = ""
     output_result: str = "LightGBM_predict_result.txt"
     input_model: str = ""
     input_init_score: str = ""
@@ -159,6 +162,7 @@ class IOConfig:
         elif require_data:
             log.fatal("No training/prediction data, application quit")
         self.verbosity = _get_int(params, "verbose", self.verbosity)
+        self.profile_dir = _get_str(params, "profile_dir", self.profile_dir)
         self.num_model_predict = _get_int(params, "num_model_predict", self.num_model_predict)
         self.is_pre_partition = _get_bool(params, "is_pre_partition", self.is_pre_partition)
         self.is_enable_sparse = _get_bool(params, "is_enable_sparse", self.is_enable_sparse)

@@ -247,9 +247,9 @@ class Dataset:
         labels_parts, weight_parts, group_parts = [], [], []
         total_rows = 0
         num_cols = None
-        for lines in parser_mod.read_line_chunks(
+        for lines in parser_mod.prefetch_chunks(parser_mod.read_line_chunks(
                 io_config.data_filename, skip_header=io_config.has_header,
-                chunk_lines=chunk_rows):
+                chunk_lines=chunk_rows)):
             parsed = parser.parse(lines)
             feats = parsed.features
             num_cols = feats.shape[1]
@@ -334,9 +334,9 @@ class Dataset:
         init_scores = [] if predict_fun is not None else None
         cursor = 0
         start = 0
-        for lines in parser_mod.read_line_chunks(
+        for lines in parser_mod.prefetch_chunks(parser_mod.read_line_chunks(
                 io_config.data_filename, skip_header=io_config.has_header,
-                chunk_lines=chunk_rows):
+                chunk_lines=chunk_rows)):
             feats = parser.parse(lines).features
             c = feats.shape[0]
             if mask is not None:
@@ -537,7 +537,13 @@ class Dataset:
         with open(path, "rb") as f:
             magic = f.read(len(BINARY_MAGIC))
             if magic != BINARY_MAGIC:
-                log.fatal("Binary file %s has wrong format" % path)
+                # documented incompatibility: the reference's .bin layout
+                # (dataset.cpp:653-898) is not interchangeable with this
+                # cache — fail with a pointer instead of parsing garbage
+                log.fatal("Binary file %s has wrong format (not a "
+                          "lightgbm_tpu cache; reference-LightGBM .bin "
+                          "files are not interchangeable — delete it to "
+                          "regenerate)" % path)
             size = int.from_bytes(f.read(8), "little")
             header = pickle.loads(f.read(size))
             bins = np.frombuffer(f.read(), dtype=np.dtype(header["bins_dtype"]))

@@ -108,12 +108,19 @@ class _DelimitedParser(Parser):
 
 
 def _parse_delimited_fast(lines: List[str], delimiter: str) -> np.ndarray:
-    """Tokenize uniform delimited lines to float64; na/nan → 0."""
+    """Tokenize uniform delimited lines to float64; na/nan → 0.
+
+    Three tiers: the native OpenMP parser (built at first use), a
+    vectorized pandas C-engine pass, then the exact-semantics per-token
+    loop (which also produces the format-error fatal for ragged input)."""
     native = _try_native()
     if native is not None:
         out = native.parse_delimited(lines, delimiter)
         if out is not None:
             return out
+    out = _parse_delimited_pandas(lines, delimiter)
+    if out is not None:
+        return out
     first_cols = len(lines[0].rstrip("\r\n").split(delimiter))
     out = np.empty((len(lines), first_cols), dtype=np.float64)
     for i, line in enumerate(lines):
@@ -274,6 +281,85 @@ def _label_idx_for_delimited(line: str, delimiter: str, num_features: int,
     if len(line.strip().split(delimiter)) == num_features:
         return -1
     return label_idx
+
+
+def _parse_delimited_pandas(lines: List[str], delimiter: str):
+    """Vectorized fallback via the pandas C engine (na/nan -> 0 like
+    _atof); returns None on any irregularity so the caller's per-token
+    loop keeps the exact reference error semantics.
+
+    pandas silently NaN-pads SHORT rows, so field counts are validated
+    up front (C-level str.count — cheap next to the parse), and quoting
+    is disabled so quoted tokens fall back to the _atof path rather than
+    being helpfully unquoted."""
+    try:
+        import csv
+        import io as _io
+        import pandas as pd
+    except ImportError:
+        return None
+    n_delim = lines[0].count(delimiter)
+    if any(ln.count(delimiter) != n_delim for ln in lines):
+        return None   # ragged input -> exact loop -> reference fatal
+    try:
+        df = pd.read_csv(_io.StringIO("\n".join(lines)), header=None,
+                         sep=delimiter, engine="c", dtype=np.float64,
+                         quoting=csv.QUOTE_NONE,
+                         na_values=["na", "nan", "NA", "NaN"])
+    except Exception:
+        return None
+    out = df.to_numpy()
+    if out.shape != (len(lines), n_delim + 1):
+        return None
+    out[np.isnan(out)] = 0.0
+    return out
+
+
+def prefetch_chunks(iterable, depth: int = 2):
+    """Overlap file reading with downstream parsing/quantization — the
+    reference's PipelineReader (utils/pipeline_reader.h:17-71: a reader
+    thread fills 16MB blocks while the parser drains them) as a bounded
+    background-thread prefetcher over any chunk iterator."""
+    import queue
+    import threading
+
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+    sentinel = object()
+    err: List[BaseException] = []
+    stop = threading.Event()
+
+    def worker():
+        try:
+            for item in iterable:
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if stop.is_set():
+                    return
+        except BaseException as e:  # surfaced on the consumer side
+            err.append(e)
+        finally:
+            try:
+                q.put_nowait(sentinel)
+            except queue.Full:
+                pass   # stop is set; worker exits regardless
+
+    threading.Thread(target=worker, daemon=True).start()
+    try:
+        while True:
+            item = q.get()
+            if item is sentinel:
+                if err:
+                    raise err[0]
+                return
+            yield item
+    finally:
+        # consumer stopped early (exception / generator close): unblock
+        # the worker so it exits and releases the underlying file handle
+        stop.set()
 
 
 def read_lines(filename: str, skip_header: bool = False) -> List[str]:

@@ -393,12 +393,19 @@ class GBDT:
         global sort included.  Validation sets ride replicated)."""
         if self.supports_chunking:
             return True
-        from ..parallel.learners import DataParallelLearner
+        from ..parallel.learners import (DataParallelLearner,
+                                         FeatureParallelLearner)
         if (isinstance(self._learner, DataParallelLearner)
                 and hasattr(self.objective, "chunk_spec")
                 and getattr(self.objective, "rows_aligned_params", False)):
             # eval-free runs never trace metric fns; otherwise every
             # metric needs a device formulation
+            return (not self._needs_eval(is_eval)
+                    or self._metrics_device_capable())
+        if (isinstance(self._learner, FeatureParallelLearner)
+                and hasattr(self.objective, "chunk_spec")):
+            # rows are replicated under feature ownership, so ANY
+            # chunk-traceable objective works (lambdarank included)
             return (not self._needs_eval(is_eval)
                     or self._metrics_device_capable())
         return False
@@ -460,7 +467,8 @@ class GBDT:
         if not self.chunk_supported(is_eval):
             raise RuntimeError(
                 "train_chunk requires a chunk-traceable objective and the "
-                "serial or data-parallel learner; any configured metric "
+                "serial, data-parallel or feature-parallel learner; any "
+                "configured metric "
                 "must have a device formulation (metrics/device.py) when "
                 "evaluation is consumed (see chunk_supported); use "
                 "train_one_iter / run_training")
@@ -479,6 +487,8 @@ class GBDT:
         valid_specs = ([[self._metric_spec(m) for m in ms]
                         for ms in self.valid_metrics] if eval_each else
                        [[] for _ in self.valid_metrics])
+        from ..parallel.learners import FeatureParallelLearner
+        fp = isinstance(self._learner, FeatureParallelLearner)
         if dp:
             fn, num_shards = self._learner.chunk_program(
                 self, obj_key, grad_fn, obj_params, has_bag, has_ff,
@@ -486,7 +496,8 @@ class GBDT:
                 valid_metric_fns=tuple(tuple(s[2] for s in specs)
                                        for specs in valid_specs),
                 n_valid=len(self.valid_datasets))
-            pad = (-self.num_data) % num_shards
+            # feature-parallel replicates rows — no shard padding
+            pad = 0 if fp else (-self.num_data) % num_shards
         else:
             fn = _get_chunk_program(
                 obj_key, grad_fn, self.num_class,
@@ -537,7 +548,17 @@ class GBDT:
         else:
             feat_masks = _arr(np.zeros((k, 1), bool))
 
-        if dp:
+        if fp:
+            own, ownmask = self._learner.chunk_args(self, num_shards)
+            new_score, vscores_out, stacked, mvals = fn(
+                self.score, self.bins_device, self.num_bins_device,
+                own, ownmask, row_masks, feat_masks, obj_params,
+                tuple(s[1] for s in train_specs),
+                tuple(e["bins"] for e in self.valid_datasets),
+                tuple(e["score"] for e in self.valid_datasets),
+                tuple(tuple(s[1] for s in specs) for specs in valid_specs))
+            self.score = new_score
+        elif dp:
             # pad rows to the shard grid once per booster; padded rows are
             # masked out of histograms/stats by valid_rows and their score
             # lane is sliced off again below

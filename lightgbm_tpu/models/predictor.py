@@ -43,20 +43,28 @@ class Predictor:
 
     def predict_file(self, data_filename: str, result_filename: str,
                      has_header: bool) -> None:
-        """Predictor::Predict (predictor.hpp:109-197)."""
+        """Predictor::Predict (predictor.hpp:109-197).
+
+        Streams the file in bounded chunks (the reference predicts
+        line-by-line off a pipelined reader; here a prefetcher thread
+        reads the next chunk while the current one predicts), so the raw
+        feature matrix never materializes whole."""
         parser = parser_mod.create_parser(data_filename, has_header,
                                           self.num_features,
                                           self.boosting.label_idx)
-        lines = parser_mod.read_lines(data_filename, skip_header=has_header)
-        parsed = parser.parse(lines)
-        result = self.predict_matrix(parsed.features)
         with open(result_filename, "w") as f:
-            if result.ndim == 1:
-                for v in result:
-                    f.write(_fmt(v) + "\n")
-            else:
-                for row in result:
-                    f.write("\t".join(_fmt(v) for v in row) + "\n")
+            for lines in parser_mod.prefetch_chunks(
+                    parser_mod.read_line_chunks(
+                        data_filename, skip_header=has_header,
+                        chunk_lines=500_000)):
+                parsed = parser.parse(lines)
+                result = self.predict_matrix(parsed.features)
+                if result.ndim == 1:
+                    for v in result:
+                        f.write(_fmt(v) + "\n")
+                else:
+                    for row in result:
+                        f.write("\t".join(_fmt(v) for v in row) + "\n")
         log.info("Finished prediction, result saved to %s" % result_filename)
 
 

@@ -21,11 +21,42 @@ def _lib_path() -> str:
     return os.path.join(os.path.dirname(__file__), "liblgbm_native.so")
 
 
+def _build():
+    """Compile the helper at first use (PipelineReader has no Python
+    analog fast enough for Higgs-scale CSVs; a one-time ~3 s g++ build
+    makes the native path the default).  Failures are silent — callers
+    fall back to the vectorized/pure-Python parsers."""
+    import shutil
+    import subprocess
+    if shutil.which("g++") is None:
+        return
+    src = os.path.join(os.path.dirname(__file__), "src", "lgbm_native.cpp")
+    if not os.path.exists(src):
+        return
+    # compile to a temp path and rename into place: another process may
+    # race first use, and a killed build must not leave a corrupt .so
+    # that permanently disables the native path
+    tmp = _lib_path() + ".%d.tmp" % os.getpid()
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-fopenmp", "-shared", "-fPIC", "-std=c++17",
+             src, "-o", tmp],
+            check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _lib_path())
+    except Exception:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
 def _load():
     global _LIB, _TRIED
     if not _TRIED:
         _TRIED = True
         path = _lib_path()
+        if not os.path.exists(path):
+            _build()
         if os.path.exists(path):
             try:
                 lib = ctypes.CDLL(path)
@@ -36,7 +67,7 @@ def _load():
                     ctypes.POINTER(ctypes.c_double),
                 ]
                 _LIB = lib
-            except OSError:
+            except Exception:   # bad/incomplete .so: missing symbols too
                 _LIB = None
     return _LIB
 

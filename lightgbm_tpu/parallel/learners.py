@@ -249,60 +249,173 @@ class DataParallelLearner(_ParallelLearnerBase):
         return tree
 
 
+def balanced_ownership(num_bins, num_shards: int):
+    """Bin-count-balanced feature ownership (the reference re-balances
+    ownership by bin count, feature_parallel_tree_learner.cpp:27-44):
+    LPT greedy — features sorted by bin count, each assigned to the
+    lightest shard with capacity.  Returns (own [S, Fs] i32 feature ids,
+    ownmask [S, Fs] bool); padded slots point at feature 0 and are masked.
+    """
+    num_bins = np.asarray(num_bins)
+    F = len(num_bins)
+    Fs = -(-F // num_shards)
+    order = np.argsort(-num_bins, kind="stable")
+    loads = np.zeros(num_shards, np.int64)
+    buckets = [[] for _ in range(num_shards)]
+    for f in order:
+        s = min((s for s in range(num_shards) if len(buckets[s]) < Fs),
+                key=lambda s: (loads[s], s))
+        buckets[s].append(int(f))
+        loads[s] += int(num_bins[f])
+    own = np.zeros((num_shards, Fs), np.int32)
+    ownmask = np.zeros((num_shards, Fs), bool)
+    for s, b in enumerate(buckets):
+        own[s, :len(b)] = sorted(b)
+        ownmask[s, :len(b)] = True
+    return own, ownmask
+
+
+def static_ownership(num_features: int, num_shards: int):
+    """Contiguous-slice ownership (no balancing) — kept for the A/B in
+    scripts/fp_ownership_bench.py."""
+    Fs = -(-num_features // num_shards)
+    own = np.minimum(np.arange(num_shards)[:, None] * Fs + np.arange(Fs),
+                     num_features - 1).astype(np.int32)
+    ownmask = (np.arange(num_shards)[:, None] * Fs
+               + np.arange(Fs)) < num_features
+    return own, ownmask
+
+
+# Compiled feature-parallel k-iteration chunk programs, shared process-wide
+_FP_CHUNK_PROGRAMS: dict = {}
+
+
 class FeatureParallelLearner(_ParallelLearnerBase):
     """Feature ownership sharded, data replicated
-    (feature_parallel_tree_learner.cpp).  The reference re-balances feature
-    ownership by bin count each tree (lines 27-44); here ownership is a
-    static contiguous slice of the (randomly ordered) feature space — the
-    result is invariant to ownership, only load balance differs."""
+    (feature_parallel_tree_learner.cpp).  Ownership is bin-count balanced
+    like the reference (lines 27-44; ``balanced_ownership``) — the result
+    is invariant to ownership, only load balance differs.  Both the
+    per-iteration path and the fused k-iteration chunk program exist; the
+    chunk runs the whole gradients → grow(SplitInfo allreduce) →
+    score-update scan under shard_map with everything except feature
+    ownership replicated."""
+
+    ownership = staticmethod(balanced_ownership)
+
+    def _ownership(self, gbdt, num_shards):
+        # constant for the dataset's lifetime: compute/upload once (the
+        # per-iteration path calls this every tree)
+        cache = getattr(self, "_own_cache", None)
+        if cache is not None and cache[0] == num_shards:
+            return cache[1], cache[2]
+        own, ownmask = type(self).ownership(
+            np.asarray(gbdt.num_bins_device), num_shards)
+        own, ownmask = jnp.asarray(own), jnp.asarray(ownmask)
+        self._own_cache = (num_shards, own, ownmask)
+        return own, ownmask
+
+    def _shard_grow_fn(self, grow, kwargs, own, ownmask):
+        """Per-shard grow closure: slice owned features, allreduce the
+        packed SplitInfo, apply splits on the replicated full matrix."""
+        def shard_grow(bins_full, grad_s, hess_s, mask_s, fmask, nbins):
+            rank = jax.lax.axis_index(FEATURE_AXIS)
+            own_s = own[rank]
+            ownok = ownmask[rank]
+            bins_own = jnp.take(bins_full, own_s, axis=0)
+            nbins_own = jnp.take(nbins, own_s)
+            fmask_own = fmask[own_s] & ownok
+
+            def finder(hist, sg, sh, cnt, nb, fm, mind, minh):
+                local = find_best_split(hist, sg, sh, cnt, nb, fm,
+                                        mind, minh)
+                local = local._replace(
+                    feature=own_s[local.feature].astype(jnp.int32))
+                return allreduce_best_split(local, FEATURE_AXIS)
+
+            return grow(
+                bins_own, grad_s, hess_s, mask_s, fmask_own, nbins_own,
+                split_finder=finder, partition_bins=bins_full, **kwargs)
+        return shard_grow
+
+    def chunk_program(self, gbdt, obj_key, grad_fn, obj_params,
+                      has_bag: bool, has_ff: bool,
+                      train_metric_fns=(), valid_metric_fns=(),
+                      n_valid: int = 0):
+        """Fused k-iteration feature-parallel chunk (same contract as the
+        data-parallel chunk_program / serial chunk program).  Rows are
+        replicated, so metric evaluation needs no gathering."""
+        mesh = get_mesh(self.config.network_config.num_machines,
+                        FEATURE_AXIS, getattr(self.config, 'device_type', ''))
+        num_shards = mesh.shape[FEATURE_AXIS]
+        num_class = gbdt.num_class
+        lr = float(gbdt.gbdt_config.learning_rate)
+        kwargs = self._grow_kwargs(gbdt)
+        grow = grow_tree_depthwise if self._depthwise else grow_tree_impl
+        max_nodes = max(_effective_num_leaves(self.tree_config) - 1, 1)
+        key = (obj_key, id(grad_fn), num_shards, num_class, lr,
+               self._depthwise, tuple(sorted(kwargs.items())), has_bag,
+               has_ff,
+               tuple(id(f) for f in train_metric_fns),
+               tuple(tuple(id(f) for f in fns) for fns in valid_metric_fns))
+        prog = _FP_CHUNK_PROGRAMS.get(key)
+        if prog is not None:
+            return prog, num_shards
+
+        lrf = jnp.float32(lr)
+
+        def shard_chunk(score, bins, num_bins, own, ownmask, row_masks,
+                        feat_masks, obj_params, train_mparams, valid_bins,
+                        valid_scores, valid_mparams):
+            from ..models.gbdt import make_chunk_body
+            body = make_chunk_body(
+                grad_fn=grad_fn, obj_params=obj_params, num_class=num_class,
+                lrf=lrf,
+                grow_fn=self._shard_grow_fn(grow, kwargs, own, ownmask),
+                has_bag=has_bag, has_ff=has_ff, bins=bins,
+                num_bins=num_bins, max_nodes=max_nodes,
+                valid_bins=valid_bins, valid_mparams=valid_mparams,
+                train_metric_fns=train_metric_fns,
+                train_mparams=train_mparams,
+                valid_metric_fns=valid_metric_fns)
+            (score, vscores), (stacked, mvals) = jax.lax.scan(
+                body, (score, tuple(valid_scores)),
+                (row_masks, feat_masks))
+            return score, vscores, stacked, mvals
+
+        prog = jax.jit(shard_map(
+            shard_chunk, mesh=mesh,
+            in_specs=(P(),) * 12,
+            out_specs=(P(), tuple(P() for _ in range(n_valid)),
+                       _tree_out_specs(None), P())))
+        _FP_CHUNK_PROGRAMS[key] = prog
+        return prog, num_shards
+
+    def chunk_args(self, gbdt, num_shards):
+        """Extra leading inputs the FP chunk program takes after num_bins."""
+        return self._ownership(gbdt, num_shards)
 
     def __call__(self, gbdt, bins, grad, hess, row_mask, feature_mask):
         mesh = get_mesh(self.config.network_config.num_machines, FEATURE_AXIS,
                         getattr(self.config, 'device_type', ''))
         num_shards = mesh.shape[FEATURE_AXIS]
-        F, N = bins.shape
-        Fs = -(-F // num_shards)  # owned features per shard
-        fpad = Fs * num_shards - F
-        if fpad:
-            # pad the feature axis so every shard's dynamic_slice is aligned
-            # with its nbins/fmask slices (padded features are masked out and
-            # can never win the split allreduce)
-            bins = jnp.pad(bins, ((0, fpad), (0, 0)))
 
         if self._jitted is None:
             kwargs = self._grow_kwargs(gbdt)
             grow = grow_tree_depthwise if self._depthwise else grow_tree_impl
 
-            def shard_fn(bins_full, grad_s, hess_s, mask_s, fmask_pad,
-                         nbins_pad):
-                rank = jax.lax.axis_index(FEATURE_AXIS)
-                offset = rank * Fs
-                bins_own = jax.lax.dynamic_slice(
-                    bins_full, (offset, jnp.int32(0)),
-                    (Fs, bins_full.shape[1]))
-                nbins_own = jax.lax.dynamic_slice(nbins_pad, (offset,), (Fs,))
-                fmask_own = jax.lax.dynamic_slice(fmask_pad, (offset,), (Fs,))
-
-                def finder(hist, sg, sh, cnt, nb, fm, mind, minh):
-                    local = find_best_split(hist, sg, sh, cnt, nb, fm,
-                                            mind, minh)
-                    local = local._replace(
-                        feature=(local.feature + offset).astype(jnp.int32))
-                    return allreduce_best_split(local, FEATURE_AXIS)
-
-                return grow(
-                    bins_own, grad_s, hess_s, mask_s, fmask_own, nbins_own,
-                    split_finder=finder, partition_bins=bins_full, **kwargs)
+            def shard_fn(bins_full, grad_s, hess_s, mask_s, fmask, nbins,
+                         own, ownmask):
+                return self._shard_grow_fn(grow, kwargs, own, ownmask)(
+                    bins_full, grad_s, hess_s, mask_s, fmask, nbins)
 
             self._jitted = jax.jit(shard_map(
                 shard_fn, mesh=mesh,
-                in_specs=(P(), P(), P(), P(), P(), P()),
+                in_specs=(P(),) * 8,
                 out_specs=_tree_out_specs(None)))
 
-        nbins_pad = jnp.pad(gbdt.num_bins_device, (0, fpad),
-                            constant_values=1)
-        fmask_pad = jnp.pad(feature_mask, (0, fpad))
-        tree = self._jitted(bins, grad, hess, row_mask, fmask_pad, nbins_pad)
+        own, ownmask = self._ownership(gbdt, num_shards)
+        tree = self._jitted(bins, grad, hess, row_mask, feature_mask,
+                            gbdt.num_bins_device, own, ownmask)
         return tree
 
 

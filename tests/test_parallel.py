@@ -208,6 +208,70 @@ def test_data_parallel_chunked_eval_early_stop(synthetic_binary):
         np.testing.assert_array_equal(t1.threshold_bin, t2.threshold_bin)
 
 
+def test_feature_parallel_chunked_matches_serial(synthetic_binary):
+    """The fused feature-parallel chunk program (ownership-sliced
+    histograms + packed SplitInfo allreduce, everything else replicated)
+    must reproduce the serial chunked trees exactly: every shard
+    histograms its owned features over ALL rows, so per-feature sums are
+    bit-identical to serial and the allreduce picks the identical global
+    best (tie-break by smaller feature id preserved)."""
+    x, y = synthetic_binary
+    x, y = x[:1999], y[:1999]
+    params = {"objective": "binary", "num_leaves": 15,
+              "min_data_in_leaf": 20, "min_sum_hessian_in_leaf": 1.0,
+              "num_iterations": 4, "learning_rate": 0.2,
+              "grow_policy": "depthwise",
+              "bagging_fraction": 0.8, "bagging_freq": 2, "bagging_seed": 5}
+    ds = Dataset.from_arrays(x, y, max_bin=32)
+
+    def make(tree_learner, machines):
+        cfg = OverallConfig()
+        p = dict(params, tree_learner=tree_learner, num_machines=machines)
+        cfg.set({k: str(v) for k, v in p.items()}, require_data=False)
+        b = GBDT()
+        obj = create_objective(cfg.objective_type, cfg.objective_config)
+        learner = None
+        if tree_learner != "serial":
+            from lightgbm_tpu.parallel import create_parallel_learner
+            learner = create_parallel_learner(cfg)
+        b.init(cfg.boosting_config, ds, obj, learner=learner)
+        return b
+
+    b_serial = make("serial", 1)
+    for _ in range(4):
+        b_serial.train_one_iter(is_eval=False)
+
+    b_fp = make("feature", 8)
+    assert b_fp.chunk_supported(False) and b_fp.chunkable_for(False)
+    stop = b_fp.train_chunk(4)
+    assert not stop
+
+    assert len(b_serial.models) == len(b_fp.models) == 4
+    for t1, t2 in zip(b_serial.models, b_fp.models):
+        assert t1.num_leaves == t2.num_leaves
+        np.testing.assert_array_equal(t1.split_feature, t2.split_feature)
+        np.testing.assert_array_equal(t1.threshold_bin, t2.threshold_bin)
+        np.testing.assert_allclose(t1.leaf_value, t2.leaf_value,
+                                   rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(b_serial.score),
+                               np.asarray(b_fp.score),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_balanced_ownership_partition():
+    """LPT bin-count balancing: every feature owned exactly once, loads
+    within one max-feature of each other (feature_parallel_tree_learner
+    .cpp:27-44 analog)."""
+    from lightgbm_tpu.parallel.learners import balanced_ownership
+    rng = np.random.RandomState(3)
+    num_bins = rng.randint(2, 256, size=29)
+    own, ownmask = balanced_ownership(num_bins, 8)
+    owned = sorted(int(f) for f in own[ownmask])
+    assert owned == list(range(29))
+    loads = [int(num_bins[own[s][ownmask[s]]].sum()) for s in range(8)]
+    assert max(loads) - min(loads) <= int(num_bins.max())
+
+
 @pytest.mark.parametrize("grow_policy", ["leafwise", "depthwise"])
 def test_data_parallel_chunked_matches_serial(synthetic_binary, grow_policy):
     """The fused data-parallel chunk program (shard_map over the whole
