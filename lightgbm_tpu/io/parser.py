@@ -302,9 +302,13 @@ def _parse_delimited_pandas(lines: List[str], delimiter: str):
     if any(ln.count(delimiter) != n_delim for ln in lines):
         return None   # ragged input -> exact loop -> reference fatal
     try:
+        # round_trip: the C engine's default xstrtod is ~1 ulp off
+        # Python float() on ~1% of tokens, which would make bin boundaries
+        # (and therefore trees) depend on which parser tier is active
         df = pd.read_csv(_io.StringIO("\n".join(lines)), header=None,
                          sep=delimiter, engine="c", dtype=np.float64,
                          quoting=csv.QUOTE_NONE,
+                         float_precision="round_trip",
                          na_values=["na", "nan", "NA", "NaN"])
     except Exception:
         return None
@@ -328,24 +332,28 @@ def prefetch_chunks(iterable, depth: int = 2):
     err: List[BaseException] = []
     stop = threading.Event()
 
+    def put_blocking(item) -> bool:
+        """Stop-aware blocking put; False when the consumer went away."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
     def worker():
         try:
             for item in iterable:
-                while not stop.is_set():
-                    try:
-                        q.put(item, timeout=0.1)
-                        break
-                    except queue.Full:
-                        continue
-                if stop.is_set():
+                if not put_blocking(item):
                     return
         except BaseException as e:  # surfaced on the consumer side
             err.append(e)
         finally:
-            try:
-                q.put_nowait(sentinel)
-            except queue.Full:
-                pass   # stop is set; worker exits regardless
+            # the sentinel must use the same stop-aware loop: dropping it
+            # on a momentarily-full queue would strand the consumer in
+            # q.get() forever (and swallow any stored producer exception)
+            put_blocking(sentinel)
 
     threading.Thread(target=worker, daemon=True).start()
     try:

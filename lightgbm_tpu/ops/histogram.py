@@ -207,7 +207,8 @@ def histogram_leafbatch(bins: jax.Array, grad: jax.Array, hess: jax.Array,
 
 def histogram_leafbatch_segsum(bins, grad, hess, col_id, col_ok,
                                num_cols: int, num_bins_max: int,
-                               chunk: int = 0, compute_dtype=None):
+                               chunk: int = 0, compute_dtype=None,
+                               axis_name=None):
     """Scatter-add leaf-batched histogram — CPU-fast oracle with the same
     [C, F, B, 3] contract as histogram_leafbatch (scatter beats the dense
     one-hot matmul off-TPU; summation ORDER differs, so f32 sums match the
