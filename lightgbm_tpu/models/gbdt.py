@@ -89,12 +89,10 @@ class GBDT:
                 device_type=getattr(getattr(learner, "config", None),
                                     "device_type", "") or "")
             max_n, _ = _pmesh.global_row_layout(N)
+            self._mp_max_n = max_n
+            self._mp_local_n = N
             self._mp_make_global = functools.partial(
                 _pmesh.make_global_rows, max_n=max_n, mesh=mesh)
-            if (boosting_config.bagging_fraction < 1.0
-                    and boosting_config.bagging_freq > 0):
-                log.fatal("bagging is not supported with multi-process "
-                          "data-parallel training yet")
             if objective is not None and not hasattr(objective, "globalize"):
                 log.fatal("objective does not support multi-process "
                           "data-parallel training (no row-aligned state "
@@ -135,8 +133,10 @@ class GBDT:
         self._use_bagging = (boosting_config.bagging_fraction < 1.0
                              and boosting_config.bagging_freq > 0)
         if self._mp:
-            # padded phantom rows must never enter histograms/root stats
-            self._bag_mask = None
+            # bagging draws over the LOCAL shard (the reference's
+            # per-machine Bagging over its partition, gbdt.cpp:106-157);
+            # padded phantom rows never enter histograms/root stats
+            self._bag_mask = np.ones(N, dtype=bool)
             self._bag_mask_device = self._row_valid
         else:
             self._bag_mask = np.ones(N, dtype=bool)
@@ -196,10 +196,13 @@ class GBDT:
             return
         frac = self.gbdt_config.bagging_fraction
         qb = self.train_data.metadata.query_boundaries
-        mask = np.zeros(self.num_data, dtype=bool)
+        # multi-process: bag the LOCAL shard, like the reference's
+        # per-machine Bagging over its own partition (gbdt.cpp:106-157)
+        n = self._mp_local_n if self._mp else self.num_data
+        mask = np.zeros(n, dtype=bool)
         if qb is None:
-            bag_cnt = int(frac * self.num_data)
-            idx = self._bag_rng.choice(self.num_data, bag_cnt, replace=False)
+            bag_cnt = int(frac * n)
+            idx = self._bag_rng.choice(n, bag_cnt, replace=False)
             mask[idx] = True
         else:
             nq = qb.size - 1
@@ -215,7 +218,10 @@ class GBDT:
     def _bagging(self, it: int) -> None:
         self._draw_bag_mask(it)
         if self._bag_mask_device is None:
-            self._bag_mask_device = jnp.asarray(self._bag_mask)
+            if self._mp:
+                self._bag_mask_device = self._mp_make_global(self._bag_mask)
+            else:
+                self._bag_mask_device = jnp.asarray(self._bag_mask)
 
     def _feature_sample(self, cls: int) -> np.ndarray:
         frac = self.tree_config.feature_fraction
@@ -531,12 +537,17 @@ class GBDT:
         # with the global-mesh program)
         _arr = np.asarray if self._mp else jnp.asarray
         if has_bag:
-            rms = np.zeros((k, C, N + pad), dtype=bool)
+            # multi-process: local draws padded to the process block, then
+            # lifted to one global row-sharded mask array
+            width = self._mp_max_n if self._mp else N + pad
+            fill = self._mp_local_n if self._mp else N
+            rms = np.zeros((k, C, width), dtype=bool)
             for i in range(k):
                 for cls in range(C):
                     self._draw_bag_mask(self.iter + i)
-                    rms[i, cls, :N] = self._bag_mask
-            row_masks = _arr(rms)
+                    rms[i, cls, :fill] = self._bag_mask
+            row_masks = (self._mp_make_global(rms, row_axis=2)
+                         if self._mp else _arr(rms))
         else:
             row_masks = _arr(np.zeros((k, 1), bool))   # scan driver only
         if has_ff:

@@ -50,7 +50,7 @@ def _free_port():
 
 
 def _write_conf(path, data_csv, model_out, tree_learner, num_machines,
-                grow_policy="depthwise"):
+                grow_policy="depthwise", extra=""):
     # hist_dtype=int8: quantization scales are pmax-synced across shards and
     # int32 accumulation is order-free, so the distributed histograms (and
     # therefore trees) are BIT-identical to serial — the strongest form of
@@ -71,6 +71,7 @@ grow_policy={grow_policy}
 tree_learner={tree_learner}
 num_machines={num_machines}
 output_model={model_out}
+{extra}
 """)
 
 
@@ -152,3 +153,38 @@ def test_two_process_data_parallel_matches_serial(tmp_path):
 
     # the run actually exercised the distributed pieces
     assert "Finished train" in outs[0]
+
+
+def test_two_process_bagging_workers_identical(tmp_path):
+    """Multi-process bagging: each process bags its LOCAL shard (the
+    reference's per-machine Bagging); the invariant is worker-identical
+    models (trees are not serial-identical — the bagged subsets differ
+    from a single-machine draw, as in the reference)."""
+    rng = np.random.RandomState(7)
+    n, f = 1600, 6
+    x = rng.randn(n, f)
+    y = ((x[:, 0] + 0.3 * rng.randn(n)) > 0).astype(int)
+    csv = str(tmp_path / "train.csv")
+    np.savetxt(csv, np.column_stack([y, x]), fmt="%.7g", delimiter=",")
+
+    port = _free_port()
+    procs = []
+    for rank in range(2):
+        conf = str(tmp_path / f"train_r{rank}.conf")
+        _write_conf(conf, csv, str(tmp_path / f"model_r{rank}.txt"),
+                    "data", 2,
+                    extra="bagging_fraction=0.8\nbagging_freq=2\n"
+                          "bagging_seed=9")
+        procs.append(_run(conf, extra_env={
+            "LGBM_TPU_COORDINATOR": f"127.0.0.1:{port}",
+            "LGBM_TPU_NUM_PROCS": "2",
+            "LGBM_TPU_PROC_ID": str(rank),
+        }))
+    outs = [p.communicate(timeout=900)[0] for p in procs]
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out[-3000:]}"
+        assert "POST process_count: 2" in out
+    m0 = open(tmp_path / "model_r0.txt").read()
+    m1 = open(tmp_path / "model_r1.txt").read()
+    assert m0 == m1, "workers diverged under bagging"
+    assert m0.count("Tree=") == 8
