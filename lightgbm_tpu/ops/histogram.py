@@ -129,10 +129,13 @@ def histogram_leafbatch(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     """
     if compute_dtype == "int8":
         # quantized-gradient path: Pallas int8-MXU kernel on TPU, the
-        # bit-identical XLA formulation elsewhere (ops/hist_pallas.py)
+        # bit-identical XLA formulation elsewhere (ops/hist_pallas.py).
+        # The Pallas kernel carries bins as int8 bit-patterns, so bin ids
+        # must fit 8 bits — max_bin > 256 datasets (int16 bins) take the
+        # XLA int formulation instead.
         import jax as _jax
         from .hist_pallas import hist_pallas_leafbatch, hist_quant_xla
-        if _jax.default_backend() == "tpu":
+        if _jax.default_backend() == "tpu" and num_bins_max <= 256:
             return hist_pallas_leafbatch(bins, grad, hess, col_id, col_ok,
                                          num_cols, num_bins_max,
                                          axis_name=axis_name)

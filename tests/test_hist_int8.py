@@ -83,6 +83,24 @@ def test_uint8_bins_above_127_not_dropped():
     assert float(via_pl[..., 2].sum()) == float(N * F)
 
 
+def test_wide_bins_int16_dispatch():
+    """max_bin > 256 stores int16 bins; the int8 dispatch must route them
+    through the XLA int formulation (the Pallas kernel's int8 bit-pattern
+    trick only covers 8-bit bin ids) and still be exact."""
+    rng = np.random.RandomState(5)
+    F, N, B, C = 3, 2000, 300, 4
+    bins = jnp.asarray(rng.randint(0, B, (F, N)).astype(np.int16))
+    grad = jnp.asarray(rng.randn(N).astype(np.float32))
+    hess = jnp.asarray(rng.rand(N).astype(np.float32))
+    cid = jnp.asarray(rng.randint(0, C, N).astype(np.int32))
+    ok = jnp.ones(N, bool)
+    a = histogram_leafbatch(bins, grad, hess, cid, ok, C, B,
+                            compute_dtype="int8")
+    b = hist_quant_xla(bins, grad, hess, cid, ok, C, B)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert float(a[..., 2].sum()) == float(N * F)
+
+
 def test_stochastic_rounding_unbiased(hist_inputs):
     bins, grad, hess, cid, ok, F, N, B, C = hist_inputs
     key = jax.random.PRNGKey(0)
