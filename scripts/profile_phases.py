@@ -43,7 +43,7 @@ def run_variant(variant: str, args) -> float:
         real = histogram.histogram_leafbatch
 
         def stub(bins, grad, hess, col_id, col_ok, num_cols, num_bins_max,
-                 chunk=65536, compute_dtype=jnp.bfloat16):
+                 chunk=65536, compute_dtype=jnp.bfloat16, axis_name=None):
             F = bins.shape[0]
             # data-dependent (not constant-foldable), trivially cheap
             seed = (jnp.sum(grad[:8]) + col_id[0].astype(jnp.float32))
@@ -62,6 +62,7 @@ def run_variant(variant: str, args) -> float:
         "objective": "binary", "num_leaves": str(args.leaves),
         "min_data_in_leaf": "100", "min_sum_hessian_in_leaf": "10.0",
         "learning_rate": "0.1", "grow_policy": "depthwise",
+        "hist_dtype": args.hist_dtype,
         "num_iterations": str(2 * args.iters),
     }, require_data=False)
 
@@ -88,9 +89,19 @@ def main():
     p.add_argument("--iters", type=int, default=8)
     p.add_argument("--variant", default="full",
                    choices=["full", "nohist"])
+    p.add_argument("--hist-dtype", default="float32",
+                   choices=["float32", "bfloat16", "int8"])
     args = p.parse_args()
+    if args.variant == "nohist" and args.hist_dtype == "int8":
+        # int8 derives root stats FROM the histogram (grower_depthwise);
+        # a stubbed histogram would grow a structurally different tree and
+        # the full-minus-nohist subtraction would compare two different
+        # programs
+        raise SystemExit("--variant nohist requires a float hist dtype "
+                         "(int8 root stats are histogram-derived)")
     rate = run_variant(args.variant, args)
     print(json.dumps({"variant": args.variant, "rows": args.rows,
+                      "hist_dtype": args.hist_dtype,
                       "iters_per_sec": round(rate, 4),
                       "sec_per_iter": round(1.0 / rate, 4)}))
 
