@@ -248,8 +248,15 @@ class GBDT:
             row_mask = self._bag_mask_device
             key = feature_mask.tobytes()
             if key not in self._feat_mask_device:
-                self._feat_mask_device.clear()  # one live entry per class mix
-                self._feat_mask_device[key] = jnp.asarray(feature_mask)
+                # one live entry suffices: the per-class feature RNGs share
+                # one seed and advance in lockstep
+                # (serial_tree_learner.cpp:159-167 parity), so every class
+                # draws the SAME mask within an iteration — one upload per
+                # redraw, hits for classes 1..C-1
+                self._feat_mask_device.clear()
+                self._feat_mask_device[key] = (
+                    np.asarray(feature_mask) if self._mp
+                    else jnp.asarray(feature_mask))
 
             tree_arrays = self._learner(
                 self, self.bins_device, grad[cls], hess[cls], row_mask,
