@@ -113,6 +113,32 @@ def test_stochastic_rounding_unbiased(hist_inputs):
     assert abs((g_deq - gm).sum()) < float(scale[0]) * np.sqrt(N) * 4
 
 
+def test_train_multiclass_int8(synthetic_binary):
+    """int8 histograms under the multiclass objective (per-class gradient
+    slices quantize with their own per-pass scales)."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.io.dataset import Dataset
+    x, _ = synthetic_binary
+    rng = np.random.RandomState(4)
+    y = ((x[:, 0] > 0).astype(int) + (x[:, 1] > 0.5).astype(int)).astype(
+        np.float32)  # 3 classes
+
+    def train(hist_dtype):
+        ds = Dataset.from_arrays(x, y, max_bin=64)
+        params = {"objective": "multiclass", "num_class": "3",
+                  "num_leaves": "15", "min_data_in_leaf": "20",
+                  "min_sum_hessian_in_leaf": "1.0",
+                  "num_iterations": "10", "learning_rate": "0.2",
+                  "grow_policy": "depthwise", "hist_dtype": hist_dtype}
+        booster = lgb.train(params, ds)
+        p = booster.predict_multiclass(x)
+        return float(np.mean(np.argmax(p, axis=1) != y))
+
+    err_f32 = train("float32")
+    err_int8 = train("int8")
+    assert err_int8 <= err_f32 + 0.02, (err_f32, err_int8)
+
+
 def test_train_depthwise_int8_quality(synthetic_binary):
     """End-to-end: int8 histograms must reach f32-comparable train error."""
     import lightgbm_tpu as lgb
