@@ -67,6 +67,35 @@ class Metadata:
             qw[i] = self.weights[lo:hi].mean() if hi > lo else 0.0
         self.query_weights = qw
 
+    def global_view(self, gather_rows) -> "Metadata":
+        """Rebuild the GLOBAL metadata from this process's row shard.
+
+        ``gather_rows(local_rows) -> global_rows`` concatenates every
+        process's row-aligned array in process order
+        (mesh.gather_ragged_rows).  Row sharding is query-atomic
+        (dataset.cpp:189-206), so
+        local query boundaries concatenate into valid global boundaries with
+        per-process row offsets.  Metrics evaluated against this view over
+        the identically-ordered gathered score reproduce the serial
+        values exactly — stronger than the reference's per-machine training
+        metrics (gbdt.cpp:225-259 evaluates each machine's local rows)."""
+        g = Metadata()
+        if self.label is not None:
+            g.set_label(gather_rows(self.label))
+        if self.weights is not None:
+            g.weights = gather_rows(self.weights)
+        # init_score is deliberately NOT gathered: metrics read only
+        # label/weights/query layout, and scores already carry it
+        if self.query_boundaries is not None:
+            # counts survive concatenation; boundaries are their cumsum
+            counts = np.diff(self.query_boundaries).astype(np.int64)
+            gcounts = gather_rows(counts)
+            boundaries = np.zeros(gcounts.size + 1, dtype=np.int32)
+            boundaries[1:] = np.cumsum(gcounts)
+            g.query_boundaries = boundaries
+            g._load_query_weights()
+        return g
+
     # --- finalization (metadata.cpp:79-160 CheckOrPartition, no-partition path) ---
 
     def set_label(self, label: np.ndarray) -> None:

@@ -88,18 +88,22 @@ class GBDT:
             mesh = _pmesh.get_mesh(
                 device_type=getattr(getattr(learner, "config", None),
                                     "device_type", "") or "")
-            max_n, _ = _pmesh.global_row_layout(N)
+            max_n, counts = _pmesh.global_row_layout(N)
             self._mp_max_n = max_n
             self._mp_local_n = N
+            self._mp_mesh = mesh
+            self._mp_true_n = int(np.sum(counts))
+            # padded-global -> true-global compaction map: process p's true
+            # rows live at [p*max_n, p*max_n + counts[p]) of the gathered
+            # row axis; metric evaluation slices these out statically
+            self._shard_layout = tuple(
+                (p * max_n, int(counts[p])) for p in range(len(counts)))
             self._mp_make_global = functools.partial(
                 _pmesh.make_global_rows, max_n=max_n, mesh=mesh)
             if objective is not None and not hasattr(objective, "globalize"):
                 log.fatal("objective does not support multi-process "
                           "data-parallel training (no row-aligned state "
                           "globalization)")
-            if training_metrics:
-                log.fatal("metric evaluation is not supported with "
-                          "multi-process data-parallel training yet")
             self.num_data = max_n * jax.process_count()
             self.bins_device = self._mp_make_global(train_data.bins,
                                                     row_axis=1)
@@ -154,20 +158,35 @@ class GBDT:
             if self._mp:
                 # lift row-aligned objective state to global sharded arrays
                 objective.globalize(self._mp_make_global)
-        for metric in self.training_metrics:
-            metric.init("training", train_data.metadata, N)
+        if self._mp and self.training_metrics:
+            # training metrics see the GLOBAL rows: rebuild the global
+            # metadata on every process (order matches the gathered global
+            # score, so values are exactly the serial run's — stronger than
+            # the reference's per-machine training metrics, gbdt.cpp:225-259)
+            from ..parallel.mesh import gather_ragged_rows
+            self._mp_train_md = train_data.metadata.global_view(
+                gather_ragged_rows)
+            for metric in self.training_metrics:
+                metric.init("training", self._mp_train_md, self._mp_true_n)
+        else:
+            for metric in self.training_metrics:
+                metric.init("training", train_data.metadata, N)
 
     def add_valid_dataset(self, valid_data, valid_metrics, name=None) -> None:
-        """GBDT::AddDataset (gbdt.cpp:92-105)."""
-        if self._mp:
-            log.fatal("validation datasets are not supported with "
-                      "multi-process data-parallel training yet")
+        """GBDT::AddDataset (gbdt.cpp:92-105).
+
+        Multi-process mode matches the reference's N-machine layout: every
+        process loads the FULL validation file (application.cpp:166-177
+        LoadValidationData takes no rank partition), so valid bins/scores
+        ride replicated — host-side numpy here, every process passing
+        identical values into the global-mesh programs."""
         idx = len(self.valid_datasets)
         name = name or f"valid_{idx + 1}"
+        _arr = np.asarray if self._mp else jnp.asarray
         entry = {
             "data": valid_data,
-            "bins": jnp.asarray(valid_data.bins),
-            "score": jnp.asarray(
+            "bins": _arr(valid_data.bins),
+            "score": _arr(
                 np.tile(valid_data.metadata.init_score, (self.num_class, 1))
                 if valid_data.metadata.init_score is not None
                 else np.zeros((self.num_class, valid_data.num_data), np.float32)),
@@ -294,16 +313,21 @@ class GBDT:
             if self.valid_datasets:
                 max_nodes = len(tree_arrays.split_feature)
                 for entry in self.valid_datasets:
-                    entry["score"] = entry["score"].at[cls].set(
-                        add_tree_score(
-                            entry["bins"], entry["score"][cls],
-                            tree_arrays.split_feature,
-                            tree_arrays.threshold_bin,
-                            tree_arrays.left_child,
-                            tree_arrays.right_child,
-                            shrunk,
-                            tree_arrays.num_leaves,
-                            max_nodes=max_nodes))
+                    new_cls = add_tree_score(
+                        entry["bins"], entry["score"][cls],
+                        tree_arrays.split_feature,
+                        tree_arrays.threshold_bin,
+                        tree_arrays.left_child,
+                        tree_arrays.right_child,
+                        shrunk,
+                        tree_arrays.num_leaves,
+                        max_nodes=max_nodes)
+                    if self._mp:
+                        # valid state stays host-side numpy in multi-process
+                        # mode (replicated inputs to the global programs)
+                        entry["score"][cls] = np.asarray(new_cls)
+                    else:
+                        entry["score"] = entry["score"].at[cls].set(new_cls)
 
             # now block on the (already in-flight) host copy for the model
             host = jax.device_get(small)
@@ -508,7 +532,8 @@ class GBDT:
                 train_metric_fns=tuple(s[2] for s in train_specs),
                 valid_metric_fns=tuple(tuple(s[2] for s in specs)
                                        for specs in valid_specs),
-                n_valid=len(self.valid_datasets))
+                n_valid=len(self.valid_datasets),
+                **({"shard_layout": self._shard_layout} if self._mp else {}))
             # feature-parallel replicates rows — no shard padding
             pad = 0 if fp else (-self.num_data) % num_shards
         else:
@@ -657,7 +682,7 @@ class GBDT:
                                              valid_before)
                     else:
                         for e, s in zip(self.valid_datasets, vscores_out):
-                            e["score"] = s
+                            e["score"] = (np.asarray(s) if self._mp else s)
                     del self.models[len(self.models) - esr * C:]
                     self.iter += kept
                     return True
@@ -667,7 +692,7 @@ class GBDT:
                                  valid_before)
         else:
             for e, s in zip(self.valid_datasets, vscores_out):
-                e["score"] = s
+                e["score"] = (np.asarray(s) if self._mp else s)
         self.iter += keep_iters
         return False
 
@@ -717,15 +742,21 @@ class GBDT:
             pad = lambda a: np.pad(np.asarray(a), (0, max_nodes - len(a)))
             leaf_vals = np.zeros(max_nodes + 1, np.float32)
             leaf_vals[:tree.num_leaves] = tree.leaf_value
-            return score.at[cls_m].set(add_tree_score(
+            new_cls = add_tree_score(
                 bins, score[cls_m],
-                jnp.asarray(pad(tree.split_feature)),
-                jnp.asarray(pad(tree.threshold_bin)),
-                jnp.asarray(pad(tree.left_child)),
-                jnp.asarray(pad(tree.right_child)),
-                jnp.asarray(leaf_vals),
-                jnp.asarray(tree.num_leaves),
-                max_nodes=max_nodes))
+                pad(tree.split_feature),
+                pad(tree.threshold_bin),
+                pad(tree.left_child),
+                pad(tree.right_child),
+                leaf_vals,
+                np.int32(tree.num_leaves),
+                max_nodes=max_nodes)
+            if isinstance(score, np.ndarray):
+                # multi-process valid scores stay host-side numpy
+                score = score.copy()
+                score[cls_m] = np.asarray(new_cls)
+                return score
+            return score.at[cls_m].set(new_cls)
 
         score = score_before
         vscores = list(valid_before)
@@ -763,13 +794,29 @@ class GBDT:
 
     # --------------------------------------------------------------- metrics
 
+    def _host_global_score(self) -> np.ndarray:
+        """Training score as a host [C, N_true] array.  Multi-process mode
+        replicates the row-sharded global score across the mesh (one
+        all_gather) and compacts out the per-process padding blocks."""
+        if not self._mp:
+            return np.asarray(self.score)
+        prog = getattr(self, "_mp_replicate_prog", None)
+        if prog is None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            prog = self._mp_replicate_prog = jax.jit(
+                lambda s: s,
+                out_shardings=NamedSharding(self._mp_mesh, PartitionSpec()))
+        full = np.asarray(prog(self.score))
+        return np.concatenate([full[:, s:s + ln]
+                               for s, ln in self._shard_layout], axis=1)
+
     def output_metric(self, iteration: int) -> bool:
         """GBDT::OutputMetric (gbdt.cpp:225-259), host-eval path."""
         freq = self.gbdt_config.output_freq
         eval_now = freq > 0 and iteration % freq == 0
         train_vals = None
         if eval_now and self.training_metrics:
-            score_np = np.asarray(self.score)
+            score_np = self._host_global_score()
             flat = (score_np.reshape(-1) if self.num_class > 1
                     else score_np[0])
             train_vals = [m.eval(flat) for m in self.training_metrics]

@@ -114,7 +114,7 @@ class DataParallelLearner(_ParallelLearnerBase):
     def chunk_program(self, gbdt, obj_key, grad_fn, obj_params,
                       has_bag: bool, has_ff: bool,
                       train_metric_fns=(), valid_metric_fns=(),
-                      n_valid: int = 0):
+                      n_valid: int = 0, shard_layout=None):
         """Fused k-iteration training program under shard_map: the whole
         gradients → grow(psum'd histograms) → score-update scan runs sharded
         over the mesh, one dispatch per chunk (the data-parallel analog of
@@ -145,6 +145,7 @@ class DataParallelLearner(_ParallelLearnerBase):
         max_nodes = max(_effective_num_leaves(self.tree_config) - 1, 1)
         key = (obj_key, id(grad_fn), num_shards, num_class, lr, depthwise,
                tuple(sorted(kwargs.items())), has_bag, has_ff, n_true,
+               shard_layout,
                tuple(id(f) for f in train_metric_fns),
                tuple(tuple(id(f) for f in fns) for fns in valid_metric_fns))
         prog = _DP_CHUNK_PROGRAMS.get(key)
@@ -156,10 +157,21 @@ class DataParallelLearner(_ParallelLearnerBase):
 
         def gathered(f):
             # train metrics need the GLOBAL score: gather the row shards
-            # and drop the tail padding before the metric formulation
+            # and compact out the padding before the metric formulation.
+            # Single-process runs pad only at the tail (slice to n_true);
+            # multi-process runs pad each process block, so the static
+            # shard_layout ((start, len) per process) concatenates the
+            # true row ranges in process order — matching the order the
+            # global metric metadata was gathered in (gbdt.init)
             def g(p, s):
                 full = jax.lax.all_gather(s, DATA_AXIS, axis=-1, tiled=True)
-                return f(p, full[..., :n_true])
+                if shard_layout is None:
+                    comp = full[..., :n_true]
+                else:
+                    comp = jnp.concatenate(
+                        [jax.lax.slice_in_dim(full, st, st + ln, axis=-1)
+                         for st, ln in shard_layout], axis=-1)
+                return f(p, comp)
             return g
 
         train_fns = tuple(gathered(f) for f in train_metric_fns)

@@ -122,6 +122,28 @@ def make_global_rows(local, max_n: int, mesh: Mesh, row_axis: int = 0,
         sharding, local, tuple(global_shape))
 
 
+def gather_ragged_rows(local) -> np.ndarray:
+    """Every process's host array, concatenated along axis 0 in process
+    order — the host-side complement of make_global_rows for UNEVEN
+    per-process lengths (row shards, per-query count vectors).  Used to
+    rebuild GLOBAL metric metadata (labels/weights/query layout) on every
+    process so distributed metric evaluation sees the same rows as a
+    serial run (gbdt.cpp:225-259 evaluates every iteration in parallel
+    mode too)."""
+    from jax.experimental import multihost_utils
+    local = np.asarray(local)
+    lengths = np.asarray(multihost_utils.process_allgather(
+        np.asarray(local.shape[0]))).reshape(-1)
+    max_len = int(lengths.max())
+    pad = max_len - local.shape[0]
+    if pad:
+        local = np.pad(local, [(0, pad)] + [(0, 0)] * (local.ndim - 1))
+    full = np.asarray(multihost_utils.process_allgather(local))
+    full = full.reshape((-1, max_len) + local.shape[1:])
+    return np.concatenate([full[p, :int(lengths[p])]
+                           for p in range(lengths.size)], axis=0)
+
+
 def sync_up_by_min(value):
     """GlobalSyncUpByMin (application.cpp:275-302): align seeds/fractions to
     the global minimum across processes for deterministic distributed runs."""

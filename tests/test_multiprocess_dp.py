@@ -50,7 +50,8 @@ def _free_port():
 
 
 def _write_conf(path, data_csv, model_out, tree_learner, num_machines,
-                grow_policy="depthwise", extra=""):
+                grow_policy="depthwise", extra="", metric_freq=1000,
+                num_iterations=8):
     # hist_dtype=int8: quantization scales are pmax-synced across shards and
     # int32 accumulation is order-free, so the distributed histograms (and
     # therefore trees) are BIT-identical to serial — the strongest form of
@@ -62,10 +63,10 @@ objective=binary
 num_leaves=15
 min_data_in_leaf=20
 min_sum_hessian_in_leaf=1.0
-num_iterations=8
+num_iterations={num_iterations}
 learning_rate=0.2
 max_bin=32
-metric_freq=1000
+metric_freq={metric_freq}
 hist_dtype=int8
 grow_policy={grow_policy}
 tree_learner={tree_learner}
@@ -188,3 +189,110 @@ def test_two_process_bagging_workers_identical(tmp_path):
     m1 = open(tmp_path / "model_r1.txt").read()
     assert m0 == m1, "workers diverged under bagging"
     assert m0.count("Tree=") == 8
+
+
+def _parse_metric_lines(out):
+    """-> {(iteration, metric_name): [values]} from the CLI log."""
+    import re
+    vals = {}
+    for m in re.finditer(
+            r"Iteration:(\d+), (.+?) : ([-\d.e+ ]+)\n", out):
+        it, name, nums = int(m.group(1)), m.group(2), m.group(3)
+        vals[(it, name)] = [float(v) for v in nums.split()]
+    return vals
+
+
+def _gen_valid_run(tmp_path, grow_policy, num_iterations, early_stop):
+    """Shared harness: 2-process DP with a validation set + metrics
+    (+ optional early stopping) vs the identical serial run.  The
+    reference's N-machine mode evaluates metrics/early-stop every
+    iteration exactly like serial (application.cpp:119-199 loads valid
+    data per machine, gbdt.cpp:225-259 evaluates each iteration)."""
+    rng = np.random.RandomState(11)
+    n, nv, f = 1600, 400, 8
+
+    def make(n_):
+        x = rng.randn(n_, f)
+        y = ((x[:, 0] - 0.5 * x[:, 1] + 0.6 * rng.randn(n_)) > 0).astype(int)
+        return np.column_stack([y, x])
+    csv = str(tmp_path / "train.csv")
+    vcsv = str(tmp_path / "valid.csv")
+    np.savetxt(csv, make(n), fmt="%.7g", delimiter=",")
+    np.savetxt(vcsv, make(nv), fmt="%.7g", delimiter=",")
+
+    extra = (f"valid_data={vcsv}\nmetric=binary_logloss,auc\n"
+             "is_training_metric=true\n")
+    if early_stop:
+        extra += "early_stopping_round=3\n"
+
+    port = _free_port()
+    procs = []
+    for rank in range(2):
+        conf = str(tmp_path / f"train_r{rank}.conf")
+        _write_conf(conf, csv, str(tmp_path / f"model_r{rank}.txt"),
+                    "data", 2, grow_policy=grow_policy, extra=extra,
+                    metric_freq=1, num_iterations=num_iterations)
+        procs.append(_run(conf, extra_env={
+            "LGBM_TPU_COORDINATOR": f"127.0.0.1:{port}",
+            "LGBM_TPU_NUM_PROCS": "2",
+            "LGBM_TPU_PROC_ID": str(rank),
+        }))
+    outs = [p.communicate(timeout=900)[0] for p in procs]
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out[-4000:]}"
+        assert "POST process_count: 2" in out
+
+    sconf = str(tmp_path / "train_serial.conf")
+    _write_conf(sconf, csv, str(tmp_path / "model_serial.txt"),
+                "serial", 1, grow_policy=grow_policy, extra=extra,
+                metric_freq=1, num_iterations=num_iterations)
+    sp = _run(sconf)
+    sout, _ = sp.communicate(timeout=900)
+    assert sp.returncode == 0, f"serial failed:\n{sout[-4000:]}"
+    return outs, sout
+
+
+def test_two_process_dp_eval_early_stop_matches_serial(tmp_path):
+    """Chunked multi-process DP with valid set + logloss/AUC + early
+    stopping: metric trajectory and the early-stop decision must match the
+    serial run (train metrics run on the gathered global score — the
+    trajectory is the serial one, not a per-machine local value)."""
+    outs, sout = _gen_valid_run(tmp_path, "depthwise",
+                                num_iterations=30, early_stop=True)
+    dp_vals = _parse_metric_lines(outs[0])
+    s_vals = _parse_metric_lines(sout)
+    assert dp_vals.keys() == s_vals.keys(), (
+        f"metric trajectories diverge:\nDP:{sorted(dp_vals)}\n"
+        f"serial:{sorted(s_vals)}")
+    assert len(dp_vals) > 0
+    for key in s_vals:
+        np.testing.assert_allclose(
+            dp_vals[key], s_vals[key], rtol=2e-5, atol=1e-7,
+            err_msg=f"metric {key}")
+
+    # identical early-stopping decision (or identical full-length run):
+    # same tree count on every worker and serially
+    m0 = open(tmp_path / "model_r0.txt").read()
+    m1 = open(tmp_path / "model_r1.txt").read()
+    ms = open(tmp_path / "model_serial.txt").read()
+    assert m0 == m1, "workers diverged"
+    assert m0.count("Tree=") == ms.count("Tree=")
+    es_dp = [l for l in outs[0].splitlines() if "Early stopping" in l]
+    es_s = [l for l in sout.splitlines() if "Early stopping" in l]
+    assert es_dp == es_s
+
+
+def test_two_process_dp_eval_leafwise_periter(tmp_path):
+    """Leaf-wise multi-process DP runs the per-iteration path: training
+    metrics evaluate host-side on the gathered global score and valid
+    scores update via tree replay — trajectory must still match serial."""
+    outs, sout = _gen_valid_run(tmp_path, "leafwise",
+                                num_iterations=8, early_stop=False)
+    dp_vals = _parse_metric_lines(outs[0])
+    s_vals = _parse_metric_lines(sout)
+    assert dp_vals.keys() == s_vals.keys()
+    assert len(dp_vals) > 0
+    for key in s_vals:
+        np.testing.assert_allclose(
+            dp_vals[key], s_vals[key], rtol=2e-5, atol=1e-7,
+            err_msg=f"metric {key}")
