@@ -100,7 +100,9 @@ class GBDT:
                 (p * max_n, int(counts[p])) for p in range(len(counts)))
             self._mp_make_global = functools.partial(
                 _pmesh.make_global_rows, max_n=max_n, mesh=mesh)
-            if objective is not None and not hasattr(objective, "globalize"):
+            if objective is not None and not (
+                    hasattr(objective, "globalize")
+                    or hasattr(objective, "globalize_layout")):
                 log.fatal("objective does not support multi-process "
                           "data-parallel training (no row-aligned state "
                           "globalization)")
@@ -154,23 +156,40 @@ class GBDT:
                            for _ in range(self.num_class)]
 
         if objective is not None:
-            objective.init(train_data.metadata, N)
-            if self._mp:
-                # lift row-aligned objective state to global sharded arrays
-                objective.globalize(self._mp_make_global)
+            if self._mp and hasattr(objective, "globalize_layout"):
+                # global-score objectives (lambdarank) build their
+                # per-query tables directly over the padded-global row
+                # layout (a local init would be discarded immediately)
+                objective.globalize_layout(
+                    self._mp_global_metadata(), self._shard_layout,
+                    self.num_data)
+            else:
+                objective.init(train_data.metadata, N)
+                if self._mp:
+                    # lift row-aligned objective state to global sharded
+                    # arrays
+                    objective.globalize(self._mp_make_global)
         if self._mp and self.training_metrics:
             # training metrics see the GLOBAL rows: rebuild the global
             # metadata on every process (order matches the gathered global
             # score, so values are exactly the serial run's — stronger than
             # the reference's per-machine training metrics, gbdt.cpp:225-259)
-            from ..parallel.mesh import gather_ragged_rows
-            self._mp_train_md = train_data.metadata.global_view(
-                gather_ragged_rows)
             for metric in self.training_metrics:
-                metric.init("training", self._mp_train_md, self._mp_true_n)
+                metric.init("training", self._mp_global_metadata(),
+                            self._mp_true_n)
         else:
             for metric in self.training_metrics:
                 metric.init("training", train_data.metadata, N)
+
+    def _mp_global_metadata(self):
+        """Cached all-process Metadata view (labels/weights/query layout in
+        process order — the compacted-global row coordinate system)."""
+        md = getattr(self, "_mp_global_md", None)
+        if md is None:
+            from ..parallel.mesh import gather_ragged_rows
+            md = self._mp_global_md = self.train_data.metadata.global_view(
+                gather_ragged_rows)
+        return md
 
     def add_valid_dataset(self, valid_data, valid_metrics, name=None) -> None:
         """GBDT::AddDataset (gbdt.cpp:92-105).
@@ -434,7 +453,9 @@ class GBDT:
                                          FeatureParallelLearner)
         if (isinstance(self._learner, DataParallelLearner)
                 and hasattr(self.objective, "chunk_spec")
-                and getattr(self.objective, "rows_aligned_params", False)):
+                and (getattr(self.objective, "rows_aligned_params", False)
+                     or getattr(self.objective, "needs_global_score",
+                                False))):
             # eval-free runs never trace metric fns; otherwise every
             # metric needs a device formulation
             return (not self._needs_eval(is_eval)
@@ -527,13 +548,17 @@ class GBDT:
         from ..parallel.learners import FeatureParallelLearner
         fp = isinstance(self._learner, FeatureParallelLearner)
         if dp:
+            extra = {} if fp else {
+                "needs_global_score": getattr(self.objective,
+                                              "needs_global_score", False)}
+            if self._mp:
+                extra["shard_layout"] = self._shard_layout
             fn, num_shards = self._learner.chunk_program(
                 self, obj_key, grad_fn, obj_params, has_bag, has_ff,
                 train_metric_fns=tuple(s[2] for s in train_specs),
                 valid_metric_fns=tuple(tuple(s[2] for s in specs)
                                        for specs in valid_specs),
-                n_valid=len(self.valid_datasets),
-                **({"shard_layout": self._shard_layout} if self._mp else {}))
+                n_valid=len(self.valid_datasets), **extra)
             # feature-parallel replicates rows — no shard padding
             pad = 0 if fp else (-self.num_data) % num_shards
         else:
@@ -609,11 +634,18 @@ class GBDT:
             if cache is None or cache[0] != num_shards:
                 bins_p = (jnp.pad(self.bins_device, ((0, 0), (0, pad)))
                           if pad else self.bins_device)
-                obj_p = jax.tree.map(
-                    lambda l: (jnp.pad(l, [(0, pad)] + [(0, 0)]
-                                       * (l.ndim - 1))
-                               if pad and getattr(l, "ndim", 0) >= 1 else l),
-                    obj_params)
+                if getattr(self.objective, "needs_global_score", False):
+                    # per-query tables are NOT row-aligned; they ride
+                    # replicated and the gradient fn handles the padded
+                    # score length itself
+                    obj_p = obj_params
+                else:
+                    obj_p = jax.tree.map(
+                        lambda l: (jnp.pad(l, [(0, pad)] + [(0, 0)]
+                                           * (l.ndim - 1))
+                                   if pad and getattr(l, "ndim", 0) >= 1
+                                   else l),
+                        obj_params)
                 if self._mp:
                     # multi-process: per-process padding is interleaved
                     # (each rank's block ends with phantom rows), and

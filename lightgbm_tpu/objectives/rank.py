@@ -29,9 +29,20 @@ K_MIN_SCORE = -np.inf
 
 
 class LambdarankNDCG:
-    # per-query tables index the GLOBAL score vector; not shardable
-    # over the data axis (data-parallel chunking falls back)
+    # per-query tables index the GLOBAL score vector, so the params are
+    # not row-shardable — instead the data-parallel learner gathers the
+    # score shards and computes the pairwise lambdas replicated, then
+    # slices each shard's rows back out (needs_global_score protocol).
+    # The reference distributes this per machine over its own queries
+    # (rank_objective.hpp:68-192 under dataset.cpp:189-206 query-atomic
+    # sharding); the replicated formulation trades S-fold redundant
+    # O(sum q^2) VPU work — a small term next to histogram building — for
+    # zero extra collectives beyond the all_gather the in-program metrics
+    # already pay, and stays correct even when device-level row blocks cut
+    # queries mid-way (only PROCESS shards are query-atomic).
     rows_aligned_params = False
+    needs_global_score = True
+
     def __init__(self, config):
         self._sigmoid = float(config.sigmoid)
         if self._sigmoid <= 0.0:
@@ -86,11 +97,37 @@ class LambdarankNDCG:
         _, params, fn = self.chunk_spec()
         return fn(params, score)
 
+    def globalize_layout(self, global_md, shard_layout,
+                         num_padded: int) -> None:
+        """Multi-process data parallel: rebuild the per-query tables over
+        the GLOBAL rows in the padded-global coordinate system.
+
+        ``global_md`` is the all-process metadata (Metadata.global_view:
+        labels/query layout concatenated in process order — valid because
+        row sharding is query-atomic, dataset.cpp:189-206);
+        ``shard_layout`` maps compacted global row c of process p to padded
+        position start_p + (c - c_p).  The rebuilt doc_index then indexes
+        the padded global score directly, and weights scatter into a
+        padded vector so the lambda products line up."""
+        self.init(global_md, int(np.sum([ln for _, ln in shard_layout])))
+        pad_pos = np.concatenate(
+            [start + np.arange(ln) for start, ln in shard_layout]
+        ).astype(np.int32)
+        doc_index = np.asarray(self.doc_index)
+        valid = np.asarray(self.valid)
+        self.doc_index = jnp.asarray(
+            np.where(valid, pad_pos[doc_index], 0).astype(np.int32))
+        if self.weights is not None:
+            w = np.zeros(num_padded, np.float32)
+            w[pad_pos] = np.asarray(self.weights)
+            self.weights = jnp.asarray(w)
+        self.num_data = num_padded
+
     def chunk_spec(self):
-        # num_data/block are static (they shape the padded query blocks);
-        # they key the cached chunk program
-        fn = functools.partial(_rank_gradients, num_data=self.num_data,
-                               block=self.block)
+        # block is static (it shapes the padded query-block map); the
+        # scatter length follows the score length at trace time, so one
+        # callable serves both the true-row and shard-padded layouts
+        fn = functools.partial(_rank_gradients, block=self.block)
         key = ("lambdarank", self.num_data, self.block, self.qmax, self.nq,
                self.weights is not None)
         return key, self.chunk_params(), _RANK_FNS.setdefault(key, fn)
@@ -117,20 +154,28 @@ class LambdarankNDCG:
 _RANK_FNS: dict = {}
 
 
-def _rank_gradients(params, score, *, num_data: int, block: int):
+def _rank_gradients(params, score, *, block: int):
     lambdas, hessians = _lambdarank_grads(
         score.astype(jnp.float32), params["doc_index"], params["valid"],
         params["labels"], params["inv_max_dcg"], params["discount"],
-        params["gains"], params["sigmoid"], num_data, block)
+        params["gains"], params["sigmoid"], block)
     if params["weights"] is not None:
-        lambdas = lambdas * params["weights"]
-        hessians = hessians * params["weights"]
+        w = params["weights"]
+        if w.shape[0] < lambdas.shape[0]:
+            # single-process DP pads rows at the tail; padded rows carry
+            # zero lambdas, so zero-padding the weights is exact
+            w = jnp.pad(w, (0, lambdas.shape[0] - w.shape[0]))
+        lambdas = lambdas * w
+        hessians = hessians * w
     return lambdas, hessians
 
 
-@functools.partial(jax.jit, static_argnames=("num_data", "block"))
+@functools.partial(jax.jit, static_argnames=("block",))
 def _lambdarank_grads(score, doc_index, valid, labels, inv_max_dcg, discount,
-                      gains, sigmoid, num_data: int, block: int):
+                      gains, sigmoid, block: int):
+    # scatter length follows the (possibly shard-padded) score; doc_index
+    # never points at padding, so padded rows get exactly zero
+    num_data = score.shape[0]
     nq, qmax = doc_index.shape
     scores_padded = jnp.where(valid, score[doc_index], K_MIN_SCORE)
 

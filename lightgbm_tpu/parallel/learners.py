@@ -114,7 +114,8 @@ class DataParallelLearner(_ParallelLearnerBase):
     def chunk_program(self, gbdt, obj_key, grad_fn, obj_params,
                       has_bag: bool, has_ff: bool,
                       train_metric_fns=(), valid_metric_fns=(),
-                      n_valid: int = 0, shard_layout=None):
+                      n_valid: int = 0, shard_layout=None,
+                      needs_global_score: bool = False):
         """Fused k-iteration training program under shard_map: the whole
         gradients → grow(psum'd histograms) → score-update scan runs sharded
         over the mesh, one dispatch per chunk (the data-parallel analog of
@@ -145,7 +146,7 @@ class DataParallelLearner(_ParallelLearnerBase):
         max_nodes = max(_effective_num_leaves(self.tree_config) - 1, 1)
         key = (obj_key, id(grad_fn), num_shards, num_class, lr, depthwise,
                tuple(sorted(kwargs.items())), has_bag, has_ff, n_true,
-               shard_layout,
+               shard_layout, needs_global_score,
                tuple(id(f) for f in train_metric_fns),
                tuple(tuple(id(f) for f in fns) for fns in valid_metric_fns))
         prog = _DP_CHUNK_PROGRAMS.get(key)
@@ -176,6 +177,29 @@ class DataParallelLearner(_ParallelLearnerBase):
 
         train_fns = tuple(gathered(f) for f in train_metric_fns)
 
+        if needs_global_score:
+            # global-score objectives (lambdarank): pairwise lambdas need
+            # every row of every query, and only PROCESS shards are
+            # query-atomic — device-level row blocks cut queries.  Gather
+            # the score shards (same collective the in-program train
+            # metrics ride), compute the full lambda vector replicated,
+            # and slice this shard's rows back out.  The reference's
+            # per-machine formulation (rank_objective.hpp:68-192) is the
+            # compute-distributed special case; this stays exact under any
+            # row blocking.
+            base_grad_fn = grad_fn
+
+            def grad_fn(params, score):
+                full = jax.lax.all_gather(score, DATA_AXIS, axis=-1,
+                                          tiled=True)
+                g, h = base_grad_fn(params, full)
+                rows = score.shape[-1]
+                i = jax.lax.axis_index(DATA_AXIS)
+                sl = functools.partial(
+                    jax.lax.dynamic_slice_in_dim,
+                    start_index=i * rows, slice_size=rows, axis=-1)
+                return sl(g), sl(h)
+
         def shard_chunk(score, bins, num_bins, valid_rows, row_masks,
                         feat_masks, obj_params, train_mparams, valid_bins,
                         valid_scores, valid_mparams):
@@ -201,10 +225,9 @@ class DataParallelLearner(_ParallelLearnerBase):
             return score, vscores, stacked, mvals
 
         def param_spec(leaf):
-            # row-aligned arrays ride the data axis; scalars are replicated
-            # (objectives with non-row tables — lambdarank — are excluded by
-            # the caller's dp-chunkable gate)
-            if getattr(leaf, "ndim", 0) >= 1:
+            # row-aligned arrays ride the data axis; scalars are replicated;
+            # global-score objectives' per-query tables ride replicated
+            if not needs_global_score and getattr(leaf, "ndim", 0) >= 1:
                 return P(DATA_AXIS, *([None] * (leaf.ndim - 1)))
             return P()
 

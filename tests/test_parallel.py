@@ -321,3 +321,65 @@ def test_data_parallel_chunked_matches_serial(synthetic_binary, grow_policy):
     np.testing.assert_allclose(np.asarray(b_serial.score),
                                np.asarray(b_dp.score),
                                rtol=1e-3, atol=1e-4)
+
+
+def test_data_parallel_chunked_lambdarank_matches_serial():
+    """DP-chunked lambdarank: pairwise lambdas need whole queries, and
+    device-level row blocks cut queries mid-way — so the chunk program
+    gathers the score shards, computes the full lambda vector replicated,
+    and slices each shard's rows (needs_global_score protocol; the
+    reference's per-machine path is rank_objective.hpp:68-192).  Trees and
+    the NDCG trajectory must match the serial per-iteration run."""
+    rng = np.random.RandomState(17)
+    nq, qsize = 40, 13          # 520 rows: NOT divisible by 8 (shard pad)
+    n = nq * qsize
+    x = rng.randn(n, 5)
+    rel = np.clip((x[:, 0] + 0.3 * rng.randn(n)) * 1.2 + 1, 0, 3).round()
+    boundaries = np.arange(0, n + 1, qsize)
+    # row weights exercise the padded-weight path (the DP chunk's lambda
+    # vector is shard-padded; weights must tail-pad to match)
+    weights = (0.5 + rng.rand(n)).astype(np.float32)
+    ds = Dataset.from_arrays(x, rel.astype(np.float32), max_bin=32,
+                             weights=weights,
+                             query_boundaries=boundaries)
+    # int8 quantized histograms: scales are pmax-synced and the psum runs
+    # in the int domain, so DP trees are BIT-identical to serial (f32
+    # psum reduction order would otherwise show through lambdarank's
+    # cancellation-heavy gradients)
+    params = {"objective": "lambdarank", "num_leaves": 15,
+              "min_data_in_leaf": 10, "min_sum_hessian_in_leaf": 1e-3,
+              "num_iterations": 4, "learning_rate": 0.1,
+              "grow_policy": "depthwise", "hist_dtype": "int8"}
+
+    def make(tree_learner, machines):
+        cfg = OverallConfig()
+        p = dict(params, tree_learner=tree_learner, num_machines=machines)
+        cfg.set({k: str(v) for k, v in p.items()}, require_data=False)
+        b = GBDT()
+        obj = create_objective(cfg.objective_type, cfg.objective_config)
+        learner = None
+        if tree_learner != "serial":
+            from lightgbm_tpu.parallel import create_parallel_learner
+            learner = create_parallel_learner(cfg)
+        b.init(cfg.boosting_config, ds, obj, learner=learner)
+        return b
+
+    b_serial = make("serial", 1)
+    for _ in range(4):
+        b_serial.train_one_iter(is_eval=False)
+
+    b_dp = make("data", 8)
+    assert b_dp.chunk_supported(False) and b_dp.chunkable_for(False)
+    stop = b_dp.train_chunk(4)
+    assert not stop
+
+    assert len(b_serial.models) == len(b_dp.models) == 4
+    for t1, t2 in zip(b_serial.models, b_dp.models):
+        assert t1.num_leaves == t2.num_leaves
+        np.testing.assert_array_equal(t1.split_feature, t2.split_feature)
+        np.testing.assert_array_equal(t1.threshold_bin, t2.threshold_bin)
+        np.testing.assert_allclose(t1.leaf_value, t2.leaf_value,
+                                   rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(b_serial.score)[:, :n],
+                               np.asarray(b_dp.score)[:, :n],
+                               rtol=1e-4, atol=1e-5)
