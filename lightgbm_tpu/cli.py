@@ -73,16 +73,25 @@ class Application:
     def load_data(self, predict_fun=None) -> None:
         """Application::LoadData (application.cpp:119-199)."""
         start = time.time()
-        num_machines = self.config.network_config.num_machines
         rank = 0
+        shard_count = 1
         bin_finder = None
-        if self.config.is_parallel:
+        if self.config.is_parallel and self.config.is_parallel_find_bin:
+            # Row shards are PER PROCESS: one process hosts every row its
+            # mesh devices train on (the data-parallel learner shards them
+            # on-device), so the reference's per-machine partition
+            # (dataset.cpp:172-216) maps to the process grid — a
+            # single-process run over N devices loads ALL rows.  Feature
+            # parallel loads full rows everywhere, exactly like the
+            # reference (is_parallel_find_bin=false for FP,
+            # io/config.cpp:164-172).
+            import jax as _jax
             from .parallel import get_rank, distributed_bin_finder
             rank = get_rank()
-            if self.config.is_parallel_find_bin:
-                bin_finder = distributed_bin_finder(self.config)
+            shard_count = _jax.process_count()
+            bin_finder = distributed_bin_finder(self.config)
         self.train_data = Dataset.load_train(
-            self.config.io_config, rank=rank, num_machines=num_machines,
+            self.config.io_config, rank=rank, num_machines=shard_count,
             predict_fun=predict_fun, bin_finder=bin_finder)
 
         self.train_metrics = []

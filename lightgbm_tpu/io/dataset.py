@@ -185,6 +185,11 @@ class Dataset:
         order of operations).  Returns used row indices or None."""
         if num_machines <= 1 or io_config.is_pre_partition:
             return None
+        # record whether the draw could honor query atomicity: an in-file
+        # group column is only extracted AFTER sharding, so its queries
+        # are cut per-record — distributed lambdarank must reject that
+        # (gbdt.init guard) rather than silently mis-train
+        self.shard_query_atomic = self.metadata.query_boundaries is not None
         rng = np.random.RandomState(io_config.data_random_seed)
         if self.metadata.query_boundaries is not None:
             nq = self.metadata.num_queries

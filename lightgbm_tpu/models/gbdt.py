@@ -49,6 +49,8 @@ class GBDT:
         self._model_file = None
         self._learner_factory: Optional[Callable] = None
         self._mp = False            # multi-process data-parallel mode
+        self._mp_fp = False         # multi-process feature-parallel mode
+        self._host_inputs = False
         self._row_valid = None
 
     # ------------------------------------------------------------------ init
@@ -82,6 +84,24 @@ class GBDT:
         # shard_map programs span the whole distributed job.
         self._mp = (jax.process_count() > 1 and learner is not None
                     and type(learner).__name__ == "DataParallelLearner")
+        # multi-process feature parallel: every process loads the FULL
+        # rows (cli.load_data, matching the reference's FP machines —
+        # io/config.cpp:164-172 sets is_parallel_find_bin=false) and the
+        # replicated-rows FP chunk program runs over the global mesh with
+        # host-side (numpy) inputs.  Only the fused depthwise chunk is
+        # lifted; the per-iteration path would push committed local
+        # arrays into the global-mesh program, so it fails loudly instead
+        # of obscurely (feature_parallel_tree_learner.cpp:9-81 is the
+        # reference's N-machine FP).
+        self._mp_fp = (jax.process_count() > 1 and learner is not None
+                       and type(learner).__name__ == "FeatureParallelLearner")
+        if self._mp_fp and self.tree_config.grow_policy != "depthwise":
+            log.fatal("multi-process feature-parallel training requires "
+                      "grow_policy=depthwise (the fused chunk program); "
+                      "leaf-wise feature parallel is single-process only")
+        # any multi-process mode keeps replicated inputs host-side (numpy):
+        # every process passes identical values into global-mesh programs
+        self._host_inputs = self._mp or self._mp_fp
         if self._mp:
             from ..parallel import mesh as _pmesh
             # same mesh the learner's shard_map programs will use
@@ -123,8 +143,12 @@ class GBDT:
             self.score = self._mp_make_global(score0, row_axis=1)
         else:
             self.num_data = N
-            self.bins_device = jnp.asarray(train_data.bins)
-            self.num_bins_device = jnp.asarray(train_data.num_bins)
+            # multi-process feature parallel keeps inputs host-side: every
+            # process passes identical (replicated) values into the
+            # global-mesh chunk program
+            _arr0 = np.asarray if self._mp_fp else jnp.asarray
+            self.bins_device = _arr0(train_data.bins)
+            self.num_bins_device = _arr0(train_data.num_bins)
             self._row_valid = None
             init_score = train_data.metadata.init_score
             if init_score is not None:
@@ -132,7 +156,7 @@ class GBDT:
                                  (self.num_class, 1))
             else:
                 score0 = np.zeros((self.num_class, N), np.float32)
-            self.score = jnp.asarray(score0)
+            self.score = _arr0(score0)
 
         # bagging state (gbdt.cpp:77-88)
         self._bag_rng = np.random.RandomState(boosting_config.bagging_seed)
@@ -159,7 +183,19 @@ class GBDT:
             if self._mp and hasattr(objective, "globalize_layout"):
                 # global-score objectives (lambdarank) build their
                 # per-query tables directly over the padded-global row
-                # layout (a local init would be discarded immediately)
+                # layout (a local init would be discarded immediately).
+                # That layout is only valid when the row shards are
+                # query-atomic (dataset.cpp:189-206) — queries from an
+                # in-file group column are extracted AFTER sharding and
+                # get cut per-record, which would silently mis-train
+                if (train_data.metadata.query_boundaries is not None
+                        and not getattr(train_data, "shard_query_atomic",
+                                        True)):
+                    log.fatal(
+                        "distributed lambdarank requires query-atomic row "
+                        "sharding: supply query ids via a .query side "
+                        "file (an in-file group column is extracted after "
+                        "sharding and splits queries across machines)")
                 objective.globalize_layout(
                     self._mp_global_metadata(), self._shard_layout,
                     self.num_data)
@@ -201,7 +237,7 @@ class GBDT:
         identical values into the global-mesh programs."""
         idx = len(self.valid_datasets)
         name = name or f"valid_{idx + 1}"
-        _arr = np.asarray if self._mp else jnp.asarray
+        _arr = np.asarray if self._host_inputs else jnp.asarray
         entry = {
             "data": valid_data,
             "bins": _arr(valid_data.bins),
@@ -380,7 +416,14 @@ class GBDT:
         """Drive the full training loop (Application::Train,
         application.cpp:239-257), fusing iterations into device chunks when
         no per-iteration metric output is needed."""
-        if not self.chunkable_for(is_eval) or num_iterations < chunk_size:
+        if self._mp_fp and not self.chunkable_for(is_eval):
+            # the per-iteration fallback would push committed local arrays
+            # into the global-mesh program and fail obscurely mid-train
+            log.fatal("multi-process feature-parallel training requires "
+                      "the fused chunk path: grow_policy=depthwise and a "
+                      "device formulation for every configured metric")
+        if not self.chunkable_for(is_eval) or (num_iterations < chunk_size
+                                               and not self._mp_fp):
             # short runs use the per-iteration path: its grower program is
             # module-jitted (shared across boosters), while a chunk shorter
             # than chunk_size would waste the surplus iterations it computes
@@ -592,7 +635,7 @@ class GBDT:
         # multi-process runs keep replicated inputs host-side (every process
         # passes identical values; a committed local jnp array would clash
         # with the global-mesh program)
-        _arr = np.asarray if self._mp else jnp.asarray
+        _arr = np.asarray if self._host_inputs else jnp.asarray
         if has_bag:
             # multi-process: local draws padded to the process block, then
             # lifted to one global row-sharded mask array
@@ -618,13 +661,20 @@ class GBDT:
 
         if fp:
             own, ownmask = self._learner.chunk_args(self, num_shards)
+            # multi-process FP: objective/metric device params were built
+            # as process-local jnp arrays; ship them host-side so every
+            # process passes identical replicated values to the
+            # global-mesh program
+            conv = ((lambda t: jax.tree.map(np.asarray, t))
+                    if self._mp_fp else (lambda t: t))
             new_score, vscores_out, stacked, mvals = fn(
                 self.score, self.bins_device, self.num_bins_device,
-                own, ownmask, row_masks, feat_masks, obj_params,
-                tuple(s[1] for s in train_specs),
+                own, ownmask, row_masks, feat_masks, conv(obj_params),
+                conv(tuple(s[1] for s in train_specs)),
                 tuple(e["bins"] for e in self.valid_datasets),
                 tuple(e["score"] for e in self.valid_datasets),
-                tuple(tuple(s[1] for s in specs) for specs in valid_specs))
+                conv(tuple(tuple(s[1] for s in specs)
+                           for specs in valid_specs)))
             self.score = new_score
         elif dp:
             # pad rows to the shard grid once per booster; padded rows are
@@ -714,7 +764,8 @@ class GBDT:
                                              valid_before)
                     else:
                         for e, s in zip(self.valid_datasets, vscores_out):
-                            e["score"] = (np.asarray(s) if self._mp else s)
+                            e["score"] = (np.asarray(s)
+                                          if self._host_inputs else s)
                     del self.models[len(self.models) - esr * C:]
                     self.iter += kept
                     return True
@@ -724,7 +775,7 @@ class GBDT:
                                  valid_before)
         else:
             for e, s in zip(self.valid_datasets, vscores_out):
-                e["score"] = (np.asarray(s) if self._mp else s)
+                e["score"] = (np.asarray(s) if self._host_inputs else s)
         self.iter += keep_iters
         return False
 

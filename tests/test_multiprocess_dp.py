@@ -388,3 +388,94 @@ output_model={model}
         np.testing.assert_allclose(
             dp_vals[key], s_vals[key], rtol=2e-5, atol=1e-7,
             err_msg=f"metric {key}")
+
+
+def test_two_process_feature_parallel_matches_serial(tmp_path):
+    """Multi-process FEATURE parallel (feature_parallel_tree_learner.cpp
+    on N machines): every process loads the FULL rows (the reference sets
+    is_parallel_find_bin=false for FP — io/config.cpp:164-172) and the
+    replicated-rows fused chunk runs over the global mesh.  Each feature's
+    histogram is built by exactly one owner from the full rows, so trees
+    must be identical on every worker AND identical to serial."""
+    rng = np.random.RandomState(41)
+    n, f = 1600, 8
+    x = rng.randn(n, f)
+    y = ((x[:, 0] - 0.5 * x[:, 1] + 0.6 * rng.randn(n)) > 0).astype(int)
+    csv = str(tmp_path / "train.csv")
+    vcsv = str(tmp_path / "valid.csv")
+    np.savetxt(csv, np.column_stack([y, x]), fmt="%.7g", delimiter=",")
+    xv = rng.randn(400, f)
+    yv = ((xv[:, 0] - 0.5 * xv[:, 1] + 0.6 * rng.randn(400)) > 0).astype(int)
+    np.savetxt(vcsv, np.column_stack([yv, xv]), fmt="%.7g", delimiter=",")
+    extra = (f"valid_data={vcsv}\nmetric=binary_logloss,auc\n"
+             "is_training_metric=true\n")
+
+    port = _free_port()
+    procs = []
+    for rank in range(2):
+        conf = str(tmp_path / f"train_r{rank}.conf")
+        _write_conf(conf, csv, str(tmp_path / f"model_r{rank}.txt"),
+                    "feature", 2, extra=extra, metric_freq=1)
+        procs.append(_run(conf, extra_env={
+            "LGBM_TPU_COORDINATOR": f"127.0.0.1:{port}",
+            "LGBM_TPU_NUM_PROCS": "2",
+            "LGBM_TPU_PROC_ID": str(rank),
+        }))
+    outs = [p.communicate(timeout=900)[0] for p in procs]
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out[-4000:]}"
+        assert "POST process_count: 2" in out
+
+    sconf = str(tmp_path / "train_serial.conf")
+    _write_conf(sconf, csv, str(tmp_path / "model_serial.txt"),
+                "serial", 1, extra=extra, metric_freq=1)
+    sp = _run(sconf)
+    sout, _ = sp.communicate(timeout=900)
+    assert sp.returncode == 0, f"serial failed:\n{sout[-4000:]}"
+
+    m0 = open(tmp_path / "model_r0.txt").read()
+    m1 = open(tmp_path / "model_r1.txt").read()
+    assert m0 == m1, "workers diverged"
+    trees_fp = _load_trees(str(tmp_path / "model_r0.txt"))
+    trees_s = _load_trees(str(tmp_path / "model_serial.txt"))
+    assert len(trees_fp) == len(trees_s) == 8
+    for k, (td, ts) in enumerate(zip(trees_fp, trees_s)):
+        assert td.num_leaves == ts.num_leaves, f"tree {k}"
+        np.testing.assert_array_equal(td.split_feature, ts.split_feature,
+                                      err_msg=f"tree {k}")
+        np.testing.assert_array_equal(td.threshold_bin, ts.threshold_bin,
+                                      err_msg=f"tree {k}")
+    dp_vals = _parse_metric_lines(outs[0])
+    s_vals = _parse_metric_lines(sout)
+    assert dp_vals.keys() == s_vals.keys() and len(dp_vals) > 0
+    for key in s_vals:
+        np.testing.assert_allclose(dp_vals[key], s_vals[key],
+                                   rtol=2e-5, atol=1e-7,
+                                   err_msg=f"metric {key}")
+
+
+def test_two_process_feature_parallel_leafwise_fails_loudly(tmp_path):
+    """Leaf-wise FP multi-process is unsupported — it must log.fatal with
+    a clear message at init, not mis-train or fail obscurely."""
+    rng = np.random.RandomState(5)
+    n, f = 400, 4
+    x = rng.randn(n, f)
+    y = (x[:, 0] > 0).astype(int)
+    csv = str(tmp_path / "train.csv")
+    np.savetxt(csv, np.column_stack([y, x]), fmt="%.7g", delimiter=",")
+
+    port = _free_port()
+    procs = []
+    for rank in range(2):
+        conf = str(tmp_path / f"train_r{rank}.conf")
+        _write_conf(conf, csv, str(tmp_path / f"model_r{rank}.txt"),
+                    "feature", 2, grow_policy="leafwise")
+        procs.append(_run(conf, extra_env={
+            "LGBM_TPU_COORDINATOR": f"127.0.0.1:{port}",
+            "LGBM_TPU_NUM_PROCS": "2",
+            "LGBM_TPU_PROC_ID": str(rank),
+        }))
+    outs = [p.communicate(timeout=900)[0] for p in procs]
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode != 0, f"rank {rank} unexpectedly succeeded"
+        assert "multi-process feature-parallel training requires" in out
