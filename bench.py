@@ -27,6 +27,38 @@ import numpy as np
 
 REFERENCE_CPU_ANCHORS = {1_000_000: 2.31, 11_000_000: 0.0559}
 
+# CUDA-LightGBM anchor (BASELINE.md "CUDA anchor" section): no number can
+# be measured here (no GPU, zero egress) and the 2016 reference predates
+# the GPU learner, so this is a documented first-principles estimate for a
+# V100/A100-class GPU running modern LightGBM's CUDA tree learner on
+# Higgs-11M / 255 leaves / 255 bins: ~1.4e9 histogram updates per tree
+# (N*F*(1+0.5*(levels-1)) with the smaller-child trick) at the
+# ~10-20 G shared-memory-atomic updates/sec such kernels sustain, plus
+# roughly equal partition/gather cost -> ~2.5 (V100) to ~5 (A100)
+# iters/sec; the anchor below is the midpoint.  1M rows mostly amortizes
+# fixed kernel-launch/partition overheads -> ~15 iters/sec.
+CUDA_ANCHORS = {1_000_000: 15.0, 11_000_000: 3.0}
+
+
+def _anchored_iters_per_sec(anchors, rows: int, flat_below: bool) -> float:
+    """Log-linear interpolation between the two anchors, linear per-row
+    cost beyond the large end.  ``flat_below``: below the small anchor the
+    CUDA estimate plateaus (fixed launch/partition overheads dominate),
+    while the reference-CPU baseline extrapolates the per-row cost
+    linearly (an upper bound — see reference_iters_per_sec)."""
+    (r0, v0), (r1, v1) = sorted(anchors.items())
+    if rows <= r0:
+        return v0 if flat_below else v0 * (r0 / rows)
+    if rows >= r1:
+        return v1 * (r1 / rows)
+    t = (math.log(rows) - math.log(r0)) / (math.log(r1) - math.log(r0))
+    return math.exp(math.log(v0) * (1 - t) + math.log(v1) * t)
+
+
+def cuda_iters_per_sec(rows: int) -> float:
+    """CUDA-LightGBM estimate at this scale (CUDA_ANCHORS above)."""
+    return _anchored_iters_per_sec(CUDA_ANCHORS, rows, flat_below=True)
+
 
 def reference_iters_per_sec(rows: int) -> float:
     """Reference-binary baseline at this scale: log-linear between anchors,
@@ -37,13 +69,8 @@ def reference_iters_per_sec(rows: int) -> float:
     is 41x slower for 11x the rows precisely because 1M still partly fits in
     LLC) — so sub-1M ``vs_baseline`` is an upper-bound estimate; the JSON
     carries a ``vs_baseline_bound`` marker there."""
-    (r0, v0), (r1, v1) = sorted(REFERENCE_CPU_ANCHORS.items())
-    if rows <= r0:
-        return v0 * (r0 / rows)
-    if rows >= r1:
-        return v1 * (r1 / rows)
-    t = (math.log(rows) - math.log(r0)) / (math.log(r1) - math.log(r0))
-    return math.exp(math.log(v0) * (1 - t) + math.log(v1) * t)
+    return _anchored_iters_per_sec(REFERENCE_CPU_ANCHORS, rows,
+                                   flat_below=False)
 
 
 def make_data(rows: int, features: int, seed: int = 42):
@@ -82,6 +109,9 @@ def main() -> int:
                              "0.005 of the reference binary — gated by "
                              "tests/test_auc_parity.py); float32 is the "
                              "reference-exact mode")
+    parser.add_argument("--skip-parity", action="store_true",
+                        help="skip the additional reference-parity "
+                             "(leafwise f32) timing pass")
     args = parser.parse_args()
     if (args.hist_dtype != "int8" and args.rows > 4_000_000
             and args.grow_policy == "depthwise"):
@@ -110,49 +140,53 @@ def main() -> int:
     x, y = make_data(args.rows, args.features)
     ds = Dataset.from_arrays(x, y, max_bin=args.max_bin)
 
-    cfg = OverallConfig()
-    cfg.set({
-        "objective": "binary",
-        "num_leaves": str(args.leaves),
-        "min_data_in_leaf": "100",
-        "min_sum_hessian_in_leaf": "10.0",
-        "learning_rate": "0.1",
-        "grow_policy": args.grow_policy,
-        "hist_chunk": str(args.hist_chunk),
-        "hist_dtype": args.hist_dtype,
-        "num_iterations": str(2 * args.iters),
-    }, require_data=False)
+    def run_config(grow_policy: str, hist_dtype: str, iters: int) -> float:
+        """Train one configuration (fresh booster, shared dataset) and
+        return timed iters/sec: one warmup round compiles + caches the
+        programs, one identical round is timed."""
+        cfg = OverallConfig()
+        cfg.set({
+            "objective": "binary",
+            "num_leaves": str(args.leaves),
+            "min_data_in_leaf": "100",
+            "min_sum_hessian_in_leaf": "10.0",
+            "learning_rate": "0.1",
+            "grow_policy": grow_policy,
+            "hist_chunk": str(args.hist_chunk),
+            "hist_dtype": hist_dtype,
+            "num_iterations": str(2 * iters),
+        }, require_data=False)
 
-    booster = GBDT()
-    objective = create_objective(cfg.objective_type, cfg.objective_config)
-    booster.init(cfg.boosting_config, ds, objective)
+        booster = GBDT()
+        objective = create_objective(cfg.objective_type,
+                                     cfg.objective_config)
+        booster.init(cfg.boosting_config, ds, objective)
 
-    # leaf-wise runs per-iteration: a fused leaf-wise chunk is one dispatch
-    # of k x 254 histogram passes, which is both slower than per-iteration
-    # dispatch AND crosses the environment's ~60 s per-dispatch execution
-    # watchdog at production shapes (BASELINE.md)
-    def run_chunks():
-        if args.grow_policy == "leafwise":
-            for i in range(args.iters):
-                if booster.train_one_iter(is_eval=False):
-                    raise SystemExit(
-                        f"training stopped after {i} iterations (no "
-                        f"splittable leaf) — bench numbers would be "
-                        f"meaningless; use more rows or fewer constraints")
-        else:
-            booster.train_chunk(args.iters)
-        jax.block_until_ready(booster.score)
+        # leaf-wise runs per-iteration: a fused leaf-wise chunk is one
+        # dispatch of k x 254 histogram passes, which is both slower than
+        # per-iteration dispatch AND crosses the environment's ~60 s
+        # per-dispatch execution watchdog at production shapes
+        # (BASELINE.md)
+        def run_chunks():
+            if grow_policy == "leafwise":
+                for i in range(iters):
+                    if booster.train_one_iter(is_eval=False):
+                        raise SystemExit(
+                            f"training stopped after {i} iterations (no "
+                            f"splittable leaf) — bench numbers would be "
+                            f"meaningless; use more rows or fewer "
+                            f"constraints")
+            else:
+                booster.train_chunk(iters)
+            jax.block_until_ready(booster.score)
 
-    # warmup: one round of the same shape compiles + caches the programs
-    # (models from warmup iterations are kept; they make the timed chunks
-    # realistic mid-training iterations)
-    run_chunks()
+        run_chunks()
+        start = time.time()
+        run_chunks()
+        return iters / (time.time() - start)
 
-    start = time.time()
-    run_chunks()
-    elapsed = time.time() - start
-
-    iters_per_sec = args.iters / elapsed
+    iters_per_sec = run_config(args.grow_policy, args.hist_dtype,
+                               args.iters)
     out = {
         "metric": f"boosting_iters_per_sec_higgs{args.rows // 1000}k_"
                   f"leaves{args.leaves}",
@@ -160,11 +194,28 @@ def main() -> int:
         "unit": "iters/sec",
         "vs_baseline": round(
             iters_per_sec / reference_iters_per_sec(args.rows), 4),
+        "vs_cuda": round(iters_per_sec / cuda_iters_per_sec(args.rows), 4),
+        "cuda_anchor_iters_per_sec": cuda_iters_per_sec(args.rows),
     }
     if args.rows < min(REFERENCE_CPU_ANCHORS):
         # sub-anchor scales extrapolate a cache-unfriendly per-row cost the
         # reference doesn't actually pay when the data fits in LLC
         out["vs_baseline_bound"] = "upper"
+
+    # the headline stacks two documented semantic departures from the
+    # reference (depthwise level order + int8 quantized gradients, both
+    # AUC-gated); price the reference-parity configuration (leafwise, f32)
+    # in the same JSON so both claims are visible (VERDICT r2 weak #2)
+    if (not args.skip_parity
+            and (args.grow_policy, args.hist_dtype) != ("leafwise",
+                                                        "float32")):
+        parity_iters = min(args.iters, 8 if args.rows > 4_000_000 else 16)
+        parity_ips = run_config("leafwise", "float32", parity_iters)
+        out["parity_leafwise_f32_iters_per_sec"] = round(parity_ips, 4)
+        out["parity_vs_baseline"] = round(
+            parity_ips / reference_iters_per_sec(args.rows), 4)
+        out["parity_vs_cuda"] = round(
+            parity_ips / cuda_iters_per_sec(args.rows), 4)
     print(json.dumps(out))
     return 0
 

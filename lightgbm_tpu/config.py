@@ -253,6 +253,16 @@ class TreeConfig:
     # paths only; the int8 Pallas kernel uses its own fixed VMEM block.
     hist_chunk: int = 0
     hist_dtype: str = "float32"
+    # data-parallel histogram reduction schedule (TreeConfig extension):
+    # "psum" allreduces the full [C,F,B,3] level histogram and searches
+    # splits replicated; "reduce_scatter" is the reference's
+    # bandwidth-optimal ownership schedule
+    # (data_parallel_tree_learner.cpp:135-235) — psum_scatter the level
+    # histograms by contiguous feature block, search only owned features,
+    # and allreduce the packed SplitInfo: ~half the collective bytes and
+    # 1/S of the split-search compute per level.  Applies to the fused
+    # depthwise data-parallel chunk; identical trees either way.
+    dp_schedule: str = "psum"
 
     def set(self, params: Dict[str, str]) -> None:
         self.min_data_in_leaf = _get_int(params, "min_data_in_leaf", self.min_data_in_leaf)
@@ -284,6 +294,11 @@ class TreeConfig:
             log.check(value in ("float32", "bfloat16", "int8"),
                       "hist_dtype must be float32, bfloat16 or int8")
             self.hist_dtype = value
+        if "dp_schedule" in params:
+            value = params["dp_schedule"].lower()
+            log.check(value in ("psum", "reduce_scatter"),
+                      "dp_schedule must be psum or reduce_scatter")
+            self.dp_schedule = value
 
 
 @dataclasses.dataclass

@@ -662,19 +662,33 @@ class GBDT:
         if fp:
             own, ownmask = self._learner.chunk_args(self, num_shards)
             # multi-process FP: objective/metric device params were built
-            # as process-local jnp arrays; ship them host-side so every
-            # process passes identical replicated values to the
-            # global-mesh program
-            conv = ((lambda t: jax.tree.map(np.asarray, t))
-                    if self._mp_fp else (lambda t: t))
+            # as process-local jnp arrays; ship them host-side ONCE so
+            # every process passes identical replicated values to the
+            # global-mesh program (the params are constant across chunks)
+            if self._mp_fp:
+                ck = (len(train_specs),
+                      tuple(len(s) for s in valid_specs))
+                cached = getattr(self, "_fp_host_params", None)
+                if cached is None or cached[0] != ck:
+                    cached = self._fp_host_params = (ck, jax.tree.map(
+                        np.asarray,
+                        (obj_params,
+                         tuple(s[1] for s in train_specs),
+                         tuple(tuple(s[1] for s in specs)
+                               for specs in valid_specs))))
+                obj_in, train_in, valid_in = cached[1]
+            else:
+                obj_in = obj_params
+                train_in = tuple(s[1] for s in train_specs)
+                valid_in = tuple(tuple(s[1] for s in specs)
+                                 for specs in valid_specs)
             new_score, vscores_out, stacked, mvals = fn(
                 self.score, self.bins_device, self.num_bins_device,
-                own, ownmask, row_masks, feat_masks, conv(obj_params),
-                conv(tuple(s[1] for s in train_specs)),
+                own, ownmask, row_masks, feat_masks, obj_in,
+                train_in,
                 tuple(e["bins"] for e in self.valid_datasets),
                 tuple(e["score"] for e in self.valid_datasets),
-                conv(tuple(tuple(s[1] for s in specs)
-                           for specs in valid_specs)))
+                valid_in)
             self.score = new_score
         elif dp:
             # pad rows to the shard grid once per booster; padded rows are

@@ -66,7 +66,9 @@ def grow_tree_depthwise(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                         hist_chunk: int = 65536, hist_reduce=None,
                         stat_reduce=None, split_finder=None,
                         partition_bins=None, hist_axis=None,
-                        compute_dtype=jnp.float32) -> TreeArrays:
+                        compute_dtype=jnp.float32,
+                        hist_reduce_level=None, int_reduce_level=None,
+                        own_slice=None) -> TreeArrays:
     """Grow one depth-wise tree.  Output contract == grow_tree_impl's
     TreeArrays (models/grower.py), so boosting/serialization/prediction are
     policy-agnostic.
@@ -80,6 +82,17 @@ def grow_tree_depthwise(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     collectives inside are batched).
     partition_bins: optional [F_global, N] matrix used to APPLY splits when
     ``bins`` is only the owned feature slice (feature-parallel).
+
+    ReduceScatter ownership schedule (the reference's bandwidth-optimal
+    data-parallel plan, data_parallel_tree_learner.cpp:135-235): the ROOT
+    pass reduces in full (root stats must be the replicated global triple),
+    ``own_slice`` then cuts each shard's contiguous feature block out of
+    the replicated root histogram, and every deeper level reduces via
+    ``hist_reduce_level`` (f32: psum_scatter on the feature axis) or
+    ``int_reduce_level`` (int8: psum_scatter of the INT accumulators,
+    preserving the bit-exactness chain).  ``split_finder`` must then map
+    block-local feature ids to global and allreduce the SplitInfo; the
+    subtraction trick works unchanged on owned blocks.
     """
     F, N = bins.shape
     L = num_leaves
@@ -92,21 +105,30 @@ def grow_tree_depthwise(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     mind = float(min_data_in_leaf)
     minh = float(min_sum_hessian_in_leaf)
 
-    def batch_hist_rows(b, g, h, col_id, col_ok, C):
+    def batch_hist_rows(b, g, h, col_id, col_ok, C, level=False):
+        # level passes may use the scatter schedule; the root pass always
+        # reduces in full
+        int_red = int_reduce_level if level else None
+        # forward int_reduce only when set: drop-in replacements
+        # (histogram_leafbatch_segsum, test/profiling stubs) don't take it
+        extra = {"int_reduce": int_red} if int_red is not None else {}
         out = histogram_leafbatch(b, g, h, col_id, col_ok, C, B,
                                   chunk=hist_chunk,
                                   compute_dtype=compute_dtype,
-                                  axis_name=hist_axis)
+                                  axis_name=hist_axis, **extra)
         # the quantized path reduces its INT accumulators internally over
         # hist_axis (bit-exactness); applying hist_reduce again would
         # double-count
-        if hist_reduce is not None and not (
-                compute_dtype == "int8" and hist_axis is not None):
-            out = hist_reduce(out)
+        if compute_dtype == "int8" and hist_axis is not None:
+            return out
+        red = (hist_reduce_level or hist_reduce) if level else hist_reduce
+        if red is not None:
+            out = red(out)
         return out
 
-    def batch_hist(col_id, col_ok, C):
-        return batch_hist_rows(bins, grad, hess, col_id, col_ok, C)
+    def batch_hist(col_id, col_ok, C, level=False):
+        return batch_hist_rows(bins, grad, hess, col_id, col_ok, C,
+                               level=level)
 
     vsplit = jax.vmap(split_finder or find_best_split,
                       in_axes=(0, 0, 0, 0, None, None, None, None))
@@ -129,6 +151,12 @@ def grow_tree_depthwise(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                                 jnp.sum(hess * maskf), jnp.sum(maskf)])
         if stat_reduce is not None:
             root_stats = stat_reduce(root_stats)
+    if own_slice is not None:
+        # ownership schedule: keep only this shard's contiguous feature
+        # block from here on (root stats above came from the full
+        # replicated histogram, so they stay bit-identical to the psum
+        # schedule)
+        hists = own_slice(hists)
 
     # per-slot level state (slot s at level d holds one candidate leaf)
     alive = jnp.ones((1,), bool)
@@ -291,7 +319,7 @@ def grow_tree_depthwise(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         # reference's per-leaf index lists, data_partition.hpp) costs more
         # in cumsum/scatter/gather plumbing than the halved histogram pass
         # saves — see git history for the removed compaction path.
-        hist_small = batch_hist(par_of_row, sel, P)
+        hist_small = batch_hist(par_of_row, sel, P, level=True)
         hist_large = hists - hist_small
         hsmall_slot = interleave(jnp.where(small_is_right[:, None, None, None],
                                            hist_large, hist_small),

@@ -175,7 +175,7 @@ def _grouped(fn, bins, grad, hess, col_id, col_ok, num_cols, B, **kw):
 def hist_pallas_leafbatch(bins, grad, hess, col_id, col_ok, num_cols: int,
                           num_bins_max: int, *, chunk: int = 2048,
                           dtype: str = "int8", rng_bits=None,
-                          axis_name=None):
+                          axis_name=None, int_reduce=None):
     """Drop-in histogram_leafbatch equivalent on the Pallas kernel.
 
     ``bins`` is the usual [F, N] matrix (int8 or uint8).  The int32
@@ -186,7 +186,8 @@ def hist_pallas_leafbatch(bins, grad, hess, col_id, col_ok, num_cols: int,
     if num_cols <= 64:
         return _hist_pallas_one(bins, grad, hess, col_id, col_ok, num_cols,
                                 num_bins_max, chunk=chunk, dtype=dtype,
-                                rng_bits=rng_bits, axis_name=axis_name)
+                                rng_bits=rng_bits, axis_name=axis_name,
+                                int_reduce=int_reduce)
     n_groups = -(-num_cols // 64)
     width = -(-num_cols // n_groups)
     parts = []
@@ -196,12 +197,13 @@ def hist_pallas_leafbatch(bins, grad, hess, col_id, col_ok, num_cols: int,
         parts.append(_hist_pallas_one(
             bins, grad, hess, col_id - base, ok, k, num_bins_max,
             chunk=chunk, dtype=dtype, rng_bits=rng_bits,
-            axis_name=axis_name))
+            axis_name=axis_name, int_reduce=int_reduce))
     return jnp.concatenate(parts, axis=0)
 
 
 def _hist_pallas_one(bins, grad, hess, col_id, col_ok, num_cols, B, *,
-                     chunk, dtype, rng_bits, axis_name=None):
+                     chunk, dtype, rng_bits, axis_name=None,
+                     int_reduce=None):
     F, N = bins.shape
     lanes = LANES if num_cols <= 42 else 192
     vals, scale = quantize_values(grad, hess, col_ok, rng_bits,
@@ -216,7 +218,12 @@ def _hist_pallas_one(bins, grad, hess, col_id, col_ok, num_cols, B, *,
     acc = hist_pallas_raw(bins.astype(jnp.int8), packed, B=B,
                           chunk=chunk, dtype=dtype,
                           lanes=lanes)                       # [F, B, lanes]
-    if axis_name is not None:
+    if int_reduce is not None:
+        # ownership schedule: psum_scatter the INT accumulators by feature
+        # block (feature axis 0) — still int-domain, still bit-exact
+        acc = int_reduce(acc)
+        F = acc.shape[0]
+    elif axis_name is not None:
         # reduce the INT accumulators across shards: dequantize-then-psum
         # would round (sum of 8 f32 products != int-sum x scale) and break
         # the bit-identical serial == data-parallel invariant
@@ -228,17 +235,17 @@ def _hist_pallas_one(bins, grad, hess, col_id, col_ok, num_cols, B, *,
 
 def hist_quant_xla(bins, grad, hess, col_id, col_ok, num_cols: int,
                    num_bins_max: int, *, chunk: int = 65536, rng_bits=None,
-                   axis_name=None):
+                   axis_name=None, int_reduce=None):
     """XLA reference of the SAME quantized-gradient math as the Pallas int8
     kernel (bit-identical output) — the CPU-testable oracle and the
     fallback on non-TPU backends."""
     return _grouped(_hist_quant_xla_one, bins, grad, hess, col_id, col_ok,
                     num_cols, num_bins_max, chunk=chunk, rng_bits=rng_bits,
-                    axis_name=axis_name)
+                    axis_name=axis_name, int_reduce=int_reduce)
 
 
 def _hist_quant_xla_one(bins, grad, hess, col_id, col_ok, num_cols, B, *,
-                        chunk, rng_bits, axis_name=None):
+                        chunk, rng_bits, axis_name=None, int_reduce=None):
     F, N = bins.shape
     C = num_cols
     # don't pad a small input up to a full default chunk
@@ -269,7 +276,10 @@ def _hist_quant_xla_one(bins, grad, hess, col_id, col_ok, num_cols, B, *,
 
     init = jnp.zeros((F, B, C * 3), jnp.int32)
     hist, _ = jax.lax.scan(body, init, (bins_c, vals_c, cid_c))
-    if axis_name is not None:
+    if int_reduce is not None:
+        hist = int_reduce(hist)                # int-domain feature scatter
+        F = hist.shape[0]
+    elif axis_name is not None:
         hist = jax.lax.psum(hist, axis_name)   # int-domain cross-shard sum
     hist = hist.reshape(F, B, C, 3).transpose(2, 0, 1, 3).astype(jnp.float32)
     return hist * scale

@@ -105,7 +105,7 @@ def histogram_leafbatch(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                         col_id: jax.Array, col_ok: jax.Array, num_cols: int,
                         num_bins_max: int, chunk: int = 65536,
                         compute_dtype=jnp.bfloat16,
-                        axis_name=None) -> jax.Array:
+                        axis_name=None, int_reduce=None) -> jax.Array:
     """Build histograms for MANY leaves in ONE matmul pass.
 
     The single-leaf one-hot matmul starves the MXU: the value operand has
@@ -138,10 +138,11 @@ def histogram_leafbatch(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         if _jax.default_backend() == "tpu" and num_bins_max <= 256:
             return hist_pallas_leafbatch(bins, grad, hess, col_id, col_ok,
                                          num_cols, num_bins_max,
-                                         axis_name=axis_name)
+                                         axis_name=axis_name,
+                                         int_reduce=int_reduce)
         return hist_quant_xla(bins, grad, hess, col_id, col_ok, num_cols,
                               num_bins_max, chunk=chunk,
-                              axis_name=axis_name)
+                              axis_name=axis_name, int_reduce=int_reduce)
     F, N = bins.shape
     B = num_bins_max
     # cap the pass at ONE 128-lane tile of the value operand (42 histogram

@@ -62,6 +62,19 @@ def allreduce_best_split(res: SplitResult, axis_name: str) -> SplitResult:
     return jax.tree.map(lambda x: x[pick], stacked)
 
 
+def ownership_finder(own_s, axis_name):
+    """Owned-block split finder shared by the feature-parallel learner and
+    the data-parallel reduce_scatter schedule: local FindBestThreshold over
+    the owned feature block, block-local -> global feature remap, then the
+    SplitInfo MaxReducer allreduce (split_info.hpp:56-104)."""
+    def finder(hist, sg, sh, cnt, nb, fm, mind, minh):
+        local = find_best_split(hist, sg, sh, cnt, nb, fm, mind, minh)
+        local = local._replace(
+            feature=own_s[local.feature].astype(jnp.int32))
+        return allreduce_best_split(local, axis_name)
+    return finder
+
+
 def _tree_out_specs(data_axis=None):
     """TreeArrays out_specs: everything replicated except the row-sharded
     leaf-id vector."""
@@ -109,7 +122,69 @@ _DP_CHUNK_PROGRAMS: dict = {}
 
 
 class DataParallelLearner(_ParallelLearnerBase):
-    """Rows sharded; histograms psum'd (data_parallel_tree_learner.cpp)."""
+    """Rows sharded; histograms psum'd (data_parallel_tree_learner.cpp).
+
+    Two histogram-reduction schedules (tree_config.dp_schedule):
+
+    - ``psum`` (default): full-histogram allreduce + replicated split
+      search — the all-to-all equivalent of the reference's reduction,
+      simplest and proven.
+    - ``reduce_scatter``: the reference's bandwidth-optimal ownership
+      schedule (data_parallel_tree_learner.cpp:135-235) as XLA
+      collectives — psum_scatter the level histograms by contiguous
+      feature block, search only owned features, allreduce the packed
+      SplitInfo (SplitInfo::MaxReducer semantics).  Halves the collective
+      bytes per level and divides split-search compute by the shard
+      count; trees are identical (bit-identical under int8)."""
+
+    def _scatter_grow_fn(self, grow, kwargs, F: int, num_shards: int):
+        """Per-shard grow closure for the reduce_scatter schedule."""
+        Fb = -(-F // num_shards)
+        Fpad = Fb * num_shards
+
+        def shard_grow(bins_s, grad_s, hess_s, mask_s, fmask, nbins):
+            rank = jax.lax.axis_index(DATA_AXIS)
+            idx = rank * Fb + jnp.arange(Fb, dtype=jnp.int32)
+            ownok = idx < F
+            own_s = jnp.minimum(idx, F - 1)
+            fmask_own = fmask[own_s] & ownok
+            nbins_own = jnp.take(nbins, own_s)
+
+            def pad_f(x, axis):
+                if Fpad == F:
+                    return x
+                widths = [(0, 0)] * x.ndim
+                widths[axis] = (0, Fpad - F)
+                return jnp.pad(x, widths)
+
+            def int_reduce(acc):
+                # INT accumulators, feature axis 0 — int-domain scatter
+                # keeps the serial == distributed bit-exactness chain
+                return jax.lax.psum_scatter(
+                    pad_f(acc, 0), DATA_AXIS, scatter_dimension=0,
+                    tiled=True)
+
+            def hist_scatter(h):
+                # f32 [C, F, B, 3] level histogram, feature axis 1
+                return jax.lax.psum_scatter(
+                    pad_f(h, 1), DATA_AXIS, scatter_dimension=1, tiled=True)
+
+            def own_slice(h):
+                # replicated full root histogram -> this shard's block
+                return jax.lax.dynamic_slice_in_dim(
+                    pad_f(h, 1), rank * Fb, Fb, axis=1)
+
+            return grow(
+                bins_s, grad_s, hess_s, mask_s, fmask_own, nbins_own,
+                hist_reduce=lambda h: jax.lax.psum(h, DATA_AXIS),
+                stat_reduce=lambda s: jax.lax.psum(s, DATA_AXIS),
+                hist_axis=DATA_AXIS,
+                split_finder=ownership_finder(own_s, DATA_AXIS),
+                hist_reduce_level=hist_scatter,
+                int_reduce_level=int_reduce,
+                own_slice=own_slice,
+                **kwargs)
+        return shard_grow
 
     def chunk_program(self, gbdt, obj_key, grad_fn, obj_params,
                       has_bag: bool, has_ff: bool,
@@ -144,9 +219,14 @@ class DataParallelLearner(_ParallelLearnerBase):
         depthwise = self._depthwise
         n_true = gbdt.num_data
         max_nodes = max(_effective_num_leaves(self.tree_config) - 1, 1)
+        # reduce_scatter applies to the fused depthwise chunk (the
+        # leaf-wise per-iteration path keeps psum)
+        use_scatter = (getattr(self.tree_config, "dp_schedule", "psum")
+                       == "reduce_scatter" and depthwise)
+        num_features = gbdt.num_features
         key = (obj_key, id(grad_fn), num_shards, num_class, lr, depthwise,
                tuple(sorted(kwargs.items())), has_bag, has_ff, n_true,
-               shard_layout, needs_global_score,
+               shard_layout, needs_global_score, use_scatter, num_features,
                tuple(id(f) for f in train_metric_fns),
                tuple(tuple(id(f) for f in fns) for fns in valid_metric_fns))
         prog = _DP_CHUNK_PROGRAMS.get(key)
@@ -204,15 +284,20 @@ class DataParallelLearner(_ParallelLearnerBase):
                         feat_masks, obj_params, train_mparams, valid_bins,
                         valid_scores, valid_mparams):
             from ..models.gbdt import make_chunk_body
-            body = make_chunk_body(
-                grad_fn=grad_fn, obj_params=obj_params, num_class=num_class,
-                lrf=lrf,
-                grow_fn=lambda *a: grow(
+            if use_scatter:
+                grow_fn = self._scatter_grow_fn(grow, kwargs, num_features,
+                                                num_shards)
+            else:
+                grow_fn = lambda *a: grow(
                     *a,
                     hist_reduce=lambda h: jax.lax.psum(h, DATA_AXIS),
                     stat_reduce=lambda s: jax.lax.psum(s, DATA_AXIS),
                     hist_axis=DATA_AXIS,
-                    **kwargs),
+                    **kwargs)
+            body = make_chunk_body(
+                grad_fn=grad_fn, obj_params=obj_params, num_class=num_class,
+                lrf=lrf,
+                grow_fn=grow_fn,
                 has_bag=has_bag, has_ff=has_ff, bins=bins,
                 num_bins=num_bins, base_mask=valid_rows,
                 max_nodes=max_nodes, valid_bins=valid_bins,
@@ -360,16 +445,10 @@ class FeatureParallelLearner(_ParallelLearnerBase):
             nbins_own = jnp.take(nbins, own_s)
             fmask_own = fmask[own_s] & ownok
 
-            def finder(hist, sg, sh, cnt, nb, fm, mind, minh):
-                local = find_best_split(hist, sg, sh, cnt, nb, fm,
-                                        mind, minh)
-                local = local._replace(
-                    feature=own_s[local.feature].astype(jnp.int32))
-                return allreduce_best_split(local, FEATURE_AXIS)
-
             return grow(
                 bins_own, grad_s, hess_s, mask_s, fmask_own, nbins_own,
-                split_finder=finder, partition_bins=bins_full, **kwargs)
+                split_finder=ownership_finder(own_s, FEATURE_AXIS),
+                partition_bins=bins_full, **kwargs)
         return shard_grow
 
     def chunk_program(self, gbdt, obj_key, grad_fn, obj_params,

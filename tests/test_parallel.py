@@ -383,3 +383,61 @@ def test_data_parallel_chunked_lambdarank_matches_serial():
     np.testing.assert_allclose(np.asarray(b_serial.score)[:, :n],
                                np.asarray(b_dp.score)[:, :n],
                                rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("hist_dtype", ["int8", "float32"])
+def test_data_parallel_reduce_scatter_matches_psum(hist_dtype):
+    """The reference's ReduceScatter ownership schedule
+    (data_parallel_tree_learner.cpp:135-235) as psum_scatter + owned-block
+    search + SplitInfo allreduce must produce the SAME trees as the
+    full-psum schedule: bit-identical under int8 (the int accumulators are
+    scattered in the int domain), and equal-structure within float
+    tolerance under f32.  F=10 is deliberately not divisible by the
+    8-shard mesh (feature padding path)."""
+    rng = np.random.RandomState(23)
+    n, f = 1999, 10
+    x = rng.randn(n, f)
+    y = ((x[:, 0] - 0.5 * x[:, 1] + 0.4 * rng.randn(n)) > 0).astype(int)
+    ds = Dataset.from_arrays(x, y.astype(np.float32), max_bin=32)
+    params = {"objective": "binary", "num_leaves": 15,
+              "min_data_in_leaf": 20, "min_sum_hessian_in_leaf": 1.0,
+              "num_iterations": 4, "learning_rate": 0.2,
+              "grow_policy": "depthwise", "hist_dtype": hist_dtype,
+              "bagging_fraction": 0.8, "bagging_freq": 2, "bagging_seed": 5}
+
+    def make(schedule):
+        cfg = OverallConfig()
+        p = dict(params, tree_learner="data", num_machines=8,
+                 dp_schedule=schedule)
+        cfg.set({k: str(v) for k, v in p.items()}, require_data=False)
+        b = GBDT()
+        obj = create_objective(cfg.objective_type, cfg.objective_config)
+        from lightgbm_tpu.parallel import create_parallel_learner
+        learner = create_parallel_learner(cfg)
+        b.init(cfg.boosting_config, ds, obj, learner=learner)
+        assert b.chunk_supported(False)
+        b.train_chunk(4)
+        return b
+
+    b_psum = make("psum")
+    b_rs = make("reduce_scatter")
+    assert len(b_psum.models) == len(b_rs.models) == 4
+    for k, (t1, t2) in enumerate(zip(b_psum.models, b_rs.models)):
+        assert t1.num_leaves == t2.num_leaves, f"tree {k}"
+        np.testing.assert_array_equal(t1.split_feature, t2.split_feature,
+                                      err_msg=f"tree {k}")
+        np.testing.assert_array_equal(t1.threshold_bin, t2.threshold_bin,
+                                      err_msg=f"tree {k}")
+        if hist_dtype == "int8":
+            # the int accumulators are identical by construction (int
+            # sums are order-free), so the histograms agree bit-for-bit;
+            # the f32 post-processing (dequantize/cumsum/outputs) is
+            # compiled per schedule and XLA's fusion/FMA choices may
+            # differ by an ulp — assert at ulp scale
+            np.testing.assert_allclose(t1.leaf_value, t2.leaf_value,
+                                       rtol=3e-7, atol=1e-9,
+                                       err_msg=f"tree {k}")
+        else:
+            np.testing.assert_allclose(t1.leaf_value, t2.leaf_value,
+                                       rtol=1e-5, atol=1e-7,
+                                       err_msg=f"tree {k}")
