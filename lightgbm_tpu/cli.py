@@ -25,6 +25,11 @@ from .utils import log
 class Application:
     def __init__(self, argv: List[str]):
         self.config = config_mod.load_config(argv)
+        # set number of threads for the native OpenMP host paths
+        # (Application::Application, application.cpp:30-34)
+        if self.config.num_threads > 0:
+            from .native import lib as native_lib
+            native_lib.set_num_threads(self.config.num_threads)
         self.boosting: GBDT = None
         self.objective = None
         self.train_data = None

@@ -251,6 +251,9 @@ class TreeConfig:
     # kernel — ~2x faster passes, grad/hess rounded to 1/127 of their
     # per-pass max; counts stay exact).  hist_chunk tunes the XLA scan
     # paths only; the int8 Pallas kernel uses its own fixed VMEM block.
+    # int8 is capped at ~16.9M GLOBAL rows (int32 accumulator: 127 x rows
+    # can wrap past 2^31 when rows concentrate in one bin — see
+    # models/gbdt.check_int8_row_capacity, which refuses loudly).
     hist_chunk: int = 0
     hist_dtype: str = "float32"
     # data-parallel histogram reduction schedule (TreeConfig extension):

@@ -18,6 +18,7 @@ accelerates tokenization when built.
 """
 from __future__ import annotations
 
+import itertools
 import math
 from dataclasses import dataclass
 from typing import List, Optional, Tuple
@@ -27,6 +28,15 @@ import numpy as np
 from ..utils import log
 
 ZERO_THRESHOLD = 1e-10  # parser.hpp:32
+
+
+# every casing of na/nan — the NA vocabulary of the reference's data files
+# (generated, not hand-enumerated: a missing casing would silently dump
+# whole files onto the slow per-token tier).  Both tiers map these to 0
+# either way; the list only controls which tier handles them.
+_NA_SPELLINGS = sorted(
+    {"".join(cs) for w in ("na", "nan")
+     for cs in itertools.product(*((c.lower(), c.upper()) for c in w))})
 
 
 def _atof(token: str) -> float:
@@ -305,11 +315,21 @@ def _parse_delimited_pandas(lines: List[str], delimiter: str):
         # round_trip: the C engine's default xstrtod is ~1 ulp off
         # Python float() on ~1% of tokens, which would make bin boundaries
         # (and therefore trees) depend on which parser tier is active
+        # keep_default_na=False: pandas' default NA vocabulary (NULL, N/A,
+        # null, #N/A, ...) is wider than _atof's (na/nan spellings only).
+        # Both tiers ultimately produce 0.0 for such tokens (_atof maps
+        # all garbage to 0 like the reference's Atof, common.h:177-178),
+        # but restricting the fast path's vocabulary keeps the TIERS'
+        # routing aligned: tokens _atof considers garbage now fail the C
+        # engine's float conversion and take the exact per-token tier,
+        # instead of silently short-circuiting through pandas' broader NA
+        # rules
         df = pd.read_csv(_io.StringIO("\n".join(lines)), header=None,
                          sep=delimiter, engine="c", dtype=np.float64,
                          quoting=csv.QUOTE_NONE,
                          float_precision="round_trip",
-                         na_values=["na", "nan", "NA", "NaN"])
+                         keep_default_na=False,
+                         na_values=_NA_SPELLINGS)
     except Exception:
         return None
     out = df.to_numpy()

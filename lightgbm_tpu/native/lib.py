@@ -50,12 +50,26 @@ def _build():
             pass
 
 
+def _src_path() -> str:
+    return os.path.join(os.path.dirname(__file__), "src", "lgbm_native.cpp")
+
+
 def _load():
     global _LIB, _TRIED
     if not _TRIED:
         _TRIED = True
         path = _lib_path()
-        if not os.path.exists(path):
+        stale = False
+        try:
+            # rebuild when the source is newer than the cached .so (new
+            # exported symbols must not silently disappear behind a stale
+            # binary)
+            stale = (os.path.exists(path)
+                     and os.path.getmtime(_src_path())
+                     > os.path.getmtime(path))
+        except OSError:
+            pass
+        if not os.path.exists(path) or stale:
             _build()
         if os.path.exists(path):
             try:
@@ -74,6 +88,19 @@ def _load():
 
 def available() -> bool:
     return _load() is not None
+
+
+def set_num_threads(n: int) -> None:
+    """Cap the native OpenMP pool (Application ctor parity,
+    application.cpp:30-34).  No-op when the library is unavailable or the
+    cached .so predates the symbol."""
+    lib = _load()
+    if lib is None or n <= 0:
+        return
+    try:
+        lib.set_num_threads(ctypes.c_int(int(n)))
+    except AttributeError:
+        pass
 
 
 def parse_delimited(lines: List[str], delimiter: str) -> Optional[np.ndarray]:

@@ -118,4 +118,14 @@ int num_threads() {
 #endif
 }
 
+// Application::Application (application.cpp:30-34): the num_threads config
+// caps the OpenMP pool for every native parallel region.
+void set_num_threads(int n) {
+#if defined(_OPENMP)
+  if (n > 0) omp_set_num_threads(n);
+#else
+  (void)n;
+#endif
+}
+
 }  // extern "C"

@@ -135,7 +135,15 @@ def histogram_leafbatch(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         # XLA int formulation instead.
         import jax as _jax
         from .hist_pallas import hist_pallas_leafbatch, hist_quant_xla
-        if _jax.default_backend() == "tpu" and num_bins_max <= 256:
+        # the Pallas kernel pins the whole [F, B, lanes] int32 accumulator
+        # in VMEM across its row grid; past ~12 MB (v5e VMEM is ~16 MB and
+        # the bins/packed operand blocks need headroom) Mosaic compilation
+        # fails, so wide datasets route to the bit-identical XLA int
+        # formulation instead of crashing
+        lanes = 128 if num_cols <= 42 else 192
+        acc_bytes = bins.shape[0] * num_bins_max * lanes * 4
+        if (_jax.default_backend() == "tpu" and num_bins_max <= 256
+                and acc_bytes <= 12 * (1 << 20)):
             return hist_pallas_leafbatch(bins, grad, hess, col_id, col_ok,
                                          num_cols, num_bins_max,
                                          axis_name=axis_name,

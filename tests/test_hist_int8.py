@@ -158,3 +158,17 @@ def test_train_depthwise_int8_quality(synthetic_binary):
     err_f32 = train("float32")
     err_int8 = train("int8")
     assert err_int8 <= err_f32 + 0.02, (err_f32, err_int8)
+
+
+def test_int8_row_capacity_guard():
+    """ADVICE r2 (medium): a histogram cell's int32 accumulator holds at
+    most 2^31/127 rows (iteration-0 binary hessians all quantize to 127,
+    and a single-bin feature concentrates every row into one cell) —
+    beyond that the booster must refuse int8 loudly, not wrap silently."""
+    from lightgbm_tpu.models.gbdt import (check_int8_row_capacity,
+                                          INT8_HIST_MAX_ROWS)
+    from lightgbm_tpu.utils.log import LightGBMError
+    check_int8_row_capacity(INT8_HIST_MAX_ROWS)       # at the limit: fine
+    check_int8_row_capacity(11_000_000)               # bench scale: fine
+    with pytest.raises(LightGBMError):
+        check_int8_row_capacity(INT8_HIST_MAX_ROWS + 1)
