@@ -62,11 +62,21 @@ class BinMapper:
             self.num_bin = max_bin
             upper_bounds = [np.inf] * max_bin
             lower_bounds = [np.inf] * max_bin
-            # sort by count, descending (ties keep value order like std::sort
-            # on pairs — reference SortForPair sorts only by key; Python's
-            # stable sort matches its stable behavior closely enough since
-            # exact tie order among equal counts does not change bin bounds
-            # materially; differential tests tolerate this)
+            # sort by count, descending.  Tie order among equal counts is
+            # provably irrelevant to the resulting bounds (dedicated-bin
+            # membership is a strict threshold over a contiguous tie run,
+            # and both the remainder and the final bins are re-sorted by
+            # value) — proven adversarially in tests/test_binning.py.
+            # DELIBERATE DIVERGENCE (PARITY.md): the reference's remainder
+            # value sort goes through Common::SortForPair
+            # (common.h:362-381), whose write-back is off by `start`; with
+            # start=bin_cnt>0 (bin.cpp:93) it DROPS the bin_cnt smallest
+            # remainder values and leaves a stale std::sort-order-dependent
+            # tail, silently losing bin boundaries on features with
+            # dedicated bins.  We implement the intended algorithm
+            # (tests/test_reference_differential.py::
+            # test_binning_count_ties_reference_sortforpair_defect pins
+            # both behaviors).
             order = sorted(range(num_values), key=lambda i: -counts[i])
             counts = [counts[i] for i in order]
             distinct_values = [distinct_values[i] for i in order]
