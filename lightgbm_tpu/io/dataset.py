@@ -86,13 +86,40 @@ class Dataset:
         self.max_bin = io_config.max_bin
 
         bin_path = io_config.data_filename + ".bin"
+        foreign_bin = False
         if os.path.exists(bin_path):
-            log.info("Loading data set from binary file")
-            self._load_binary(bin_path, rank, num_machines,
-                              io_config.is_pre_partition,
-                              io_config.data_random_seed)
-            self._attach_init_score(io_config.input_init_score, predict_fun)
-            return self
+            kind = self._classify_binary_cache(bin_path)
+            if kind == "ours":
+                log.info("Loading data set from binary file")
+                self._load_binary(bin_path, rank, num_machines,
+                                  io_config.is_pre_partition,
+                                  io_config.data_random_seed)
+                self._attach_init_score(io_config.input_init_score,
+                                        predict_fun)
+                return self
+            if kind == "corrupt":
+                log.fatal("Binary file %s is a corrupt/truncated "
+                          "lightgbm_tpu cache — delete it to regenerate"
+                          % bin_path)
+            # a reference-LightGBM cache (dataset.cpp:653-898 layout, no
+            # magic) sitting next to the data file: re-bin from the text
+            # file instead of hard-stopping the run, and never clobber the
+            # user's still-valid reference cache
+            foreign_bin = True
+            if not os.path.exists(io_config.data_filename):
+                log.fatal("Binary file %s is a reference-LightGBM cache "
+                          "(not loadable by lightgbm_tpu) and the text "
+                          "data file %s does not exist"
+                          % (bin_path, io_config.data_filename))
+            log.warning("Binary file %s is a reference-LightGBM cache; "
+                        "lightgbm_tpu caches use their own format — "
+                        "re-binning from the text file (the reference "
+                        "cache is left untouched)" % bin_path)
+            if io_config.is_save_binary_file:
+                log.warning("is_save_binary_file requested but %s is a "
+                            "reference cache — NOT overwriting it; delete "
+                            "or move it to let lightgbm_tpu write its own"
+                            % bin_path)
 
         label_idx, weight_idx, group_idx, ignore_set, header_names = \
             _resolve_columns(io_config)
@@ -112,7 +139,7 @@ class Dataset:
                 io_config, parser, rank, num_machines, predict_fun,
                 bin_finder, weight_idx, group_idx, ignore_set, header_names)
             self.metadata.finalize(self.num_data)
-            if io_config.is_save_binary_file:
+            if io_config.is_save_binary_file and not foreign_bin:
                 self.save_binary(bin_path)
             return self
         lines = parser_mod.read_lines(io_config.data_filename,
@@ -173,7 +200,7 @@ class Dataset:
         self.metadata.finalize(self.num_data)
 
         self._attach_init_score_values(features, predict_fun)
-        if io_config.is_save_binary_file:
+        if io_config.is_save_binary_file and not foreign_bin:
             self.save_binary(bin_path)
         return self
 
@@ -537,18 +564,28 @@ class Dataset:
             f.write(np.ascontiguousarray(self.bins).tobytes())
         log.info("Saved binary data file to %s" % path)
 
+    @staticmethod
+    def _classify_binary_cache(path: str) -> str:
+        """'ours' (magic match) / 'corrupt' (truncated or partially-written
+        lightgbm_tpu cache) / 'foreign' (anything else — the reference's
+        .bin layout, dataset.cpp:653-898, starts with a raw size_t header
+        size and carries no magic).  A crash during save_binary must not be
+        misdiagnosed as a reference cache: that would silently suppress
+        both the cache load AND regeneration forever."""
+        with open(path, "rb") as f:
+            head = f.read(len(BINARY_MAGIC))
+        if head == BINARY_MAGIC:
+            return "ours"
+        if len(head) < len(BINARY_MAGIC) or head.startswith(b"LGBM_TPU"):
+            return "corrupt"
+        return "foreign"
+
     def _load_binary(self, path: str, rank: int, num_machines: int,
                      is_pre_partition: bool, data_random_seed: int = 1) -> None:
         with open(path, "rb") as f:
-            magic = f.read(len(BINARY_MAGIC))
-            if magic != BINARY_MAGIC:
-                # documented incompatibility: the reference's .bin layout
-                # (dataset.cpp:653-898) is not interchangeable with this
-                # cache — fail with a pointer instead of parsing garbage
-                log.fatal("Binary file %s has wrong format (not a "
-                          "lightgbm_tpu cache; reference-LightGBM .bin "
-                          "files are not interchangeable — delete it to "
-                          "regenerate)" % path)
+            # format already validated by _classify_binary_cache (the only
+            # caller gates on it); skip past the magic
+            f.read(len(BINARY_MAGIC))
             size = int.from_bytes(f.read(8), "little")
             header = pickle.loads(f.read(size))
             bins = np.frombuffer(f.read(), dtype=np.dtype(header["bins_dtype"]))
