@@ -556,39 +556,59 @@ class Dataset:
             "weights": self.metadata.weights,
             "query_boundaries": self.metadata.query_boundaries,
         }
-        with open(path, "wb") as f:
-            f.write(BINARY_MAGIC)
-            blob = pickle.dumps(header)
-            f.write(len(blob).to_bytes(8, "little"))
-            f.write(blob)
-            f.write(np.ascontiguousarray(self.bins).tobytes())
+        # atomic write (temp + rename): a crash mid-save must not leave a
+        # partial cache that a later run would misparse
+        tmp = path + ".%d.tmp" % os.getpid()
+        try:
+            with open(tmp, "wb") as f:
+                f.write(BINARY_MAGIC)
+                blob = pickle.dumps(header)
+                f.write(len(blob).to_bytes(8, "little"))
+                f.write(blob)
+                f.write(np.ascontiguousarray(self.bins).tobytes())
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
         log.info("Saved binary data file to %s" % path)
 
     @staticmethod
     def _classify_binary_cache(path: str) -> str:
-        """'ours' (magic match) / 'corrupt' (truncated or partially-written
-        lightgbm_tpu cache) / 'foreign' (anything else — the reference's
-        .bin layout, dataset.cpp:653-898, starts with a raw size_t header
-        size and carries no magic).  A crash during save_binary must not be
-        misdiagnosed as a reference cache: that would silently suppress
-        both the cache load AND regeneration forever."""
+        """'ours' (magic match) / 'corrupt' (a damaged lightgbm_tpu cache
+        — recognizable magic prefix but not the full magic) / 'foreign'
+        (anything else: the reference's .bin layout, dataset.cpp:653-898,
+        starts with a raw size_t header size and carries no magic, and a
+        0-byte crash artifact from any other tool is equally not ours).
+        save_binary writes atomically, so 'corrupt' is a best-effort
+        diagnosis for caches damaged after the fact; _load_binary's parser
+        reports anything that slips through."""
         with open(path, "rb") as f:
             head = f.read(len(BINARY_MAGIC))
         if head == BINARY_MAGIC:
             return "ours"
-        if len(head) < len(BINARY_MAGIC) or head.startswith(b"LGBM_TPU"):
+        if head[:8] == b"LGBM_TPU":
             return "corrupt"
         return "foreign"
 
     def _load_binary(self, path: str, rank: int, num_machines: int,
                      is_pre_partition: bool, data_random_seed: int = 1) -> None:
-        with open(path, "rb") as f:
-            # format already validated by _classify_binary_cache (the only
-            # caller gates on it); skip past the magic
-            f.read(len(BINARY_MAGIC))
-            size = int.from_bytes(f.read(8), "little")
-            header = pickle.loads(f.read(size))
-            bins = np.frombuffer(f.read(), dtype=np.dtype(header["bins_dtype"]))
+        try:
+            with open(path, "rb") as f:
+                # format already validated by _classify_binary_cache (the
+                # only caller gates on it); skip past the magic
+                f.read(len(BINARY_MAGIC))
+                size = int.from_bytes(f.read(8), "little")
+                header = pickle.loads(f.read(size))
+                bins = np.frombuffer(f.read(),
+                                     dtype=np.dtype(header["bins_dtype"]))
+        except log.LightGBMError:
+            raise
+        except Exception as e:
+            log.fatal("Binary file %s is a damaged lightgbm_tpu cache "
+                      "(%s) — delete it to regenerate" % (path, e))
         self.num_data = header["num_data"]
         self.global_num_data = header["global_num_data"]
         self.num_total_features = header["num_total_features"]

@@ -51,7 +51,7 @@ def _free_port():
 
 def _write_conf(path, data_csv, model_out, tree_learner, num_machines,
                 grow_policy="depthwise", extra="", metric_freq=1000,
-                num_iterations=8):
+                num_iterations=8, objective="binary"):
     # hist_dtype=int8: quantization scales are pmax-synced across shards and
     # int32 accumulation is order-free, so the distributed histograms (and
     # therefore trees) are BIT-identical to serial — the strongest form of
@@ -59,7 +59,7 @@ def _write_conf(path, data_csv, model_out, tree_learner, num_machines,
     with open(path, "w") as f:
         f.write(f"""task=train
 data={data_csv}
-objective=binary
+objective={objective}
 num_leaves=15
 min_data_in_leaf=20
 min_sum_hessian_in_leaf=1.0
@@ -479,3 +479,121 @@ def test_two_process_feature_parallel_leafwise_fails_loudly(tmp_path):
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode != 0, f"rank {rank} unexpectedly succeeded"
         assert "multi-process feature-parallel training requires" in out
+
+
+def test_two_process_dp_multiclass_matches_serial(tmp_path):
+    """Multi-process DP multiclass (k trees per iteration interleaved,
+    gbdt.cpp:175-195): worker-identical AND serial-identical trees under
+    int8, with multi_logloss evaluated on the gathered global score."""
+    rng = np.random.RandomState(13)
+    n, f, k = 1500, 6, 3
+    x = rng.randn(n, f)
+    y = (x[:, 0] + 0.5 * rng.randn(n) > 0.5).astype(int) + \
+        (x[:, 1] + 0.5 * rng.randn(n) > 0).astype(int)
+    csv = str(tmp_path / "train.csv")
+    np.savetxt(csv, np.column_stack([y, x]), fmt="%.7g", delimiter=",")
+    extra = (f"num_class={k}\nmetric=multi_logloss\n"
+             "is_training_metric=true\n")
+
+    port = _free_port()
+    procs = []
+    for rank in range(2):
+        conf = str(tmp_path / f"train_r{rank}.conf")
+        _write_conf(conf, csv, str(tmp_path / f"model_r{rank}.txt"),
+                    "data", 2, extra=extra, metric_freq=1,
+                    objective="multiclass")
+        procs.append(_run(conf, extra_env={
+            "LGBM_TPU_COORDINATOR": f"127.0.0.1:{port}",
+            "LGBM_TPU_NUM_PROCS": "2",
+            "LGBM_TPU_PROC_ID": str(rank),
+        }))
+    outs = [p.communicate(timeout=900)[0] for p in procs]
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out[-4000:]}"
+        assert "POST process_count: 2" in out
+
+    sconf = str(tmp_path / "train_serial.conf")
+    _write_conf(sconf, csv, str(tmp_path / "model_serial.txt"),
+                "serial", 1, extra=extra, metric_freq=1,
+                objective="multiclass")
+    sp = _run(sconf)
+    sout, _ = sp.communicate(timeout=900)
+    assert sp.returncode == 0, f"serial failed:\n{sout[-4000:]}"
+
+    m0 = open(tmp_path / "model_r0.txt").read()
+    m1 = open(tmp_path / "model_r1.txt").read()
+    assert m0 == m1, "workers diverged"
+    trees_dp = _load_trees(str(tmp_path / "model_r0.txt"))
+    trees_s = _load_trees(str(tmp_path / "model_serial.txt"))
+    assert len(trees_dp) == len(trees_s) == 8 * k
+    for i, (td, ts) in enumerate(zip(trees_dp, trees_s)):
+        np.testing.assert_array_equal(td.split_feature, ts.split_feature,
+                                      err_msg=f"tree {i}")
+        np.testing.assert_array_equal(td.threshold_bin, ts.threshold_bin,
+                                      err_msg=f"tree {i}")
+    dp_vals = _parse_metric_lines(outs[0])
+    s_vals = _parse_metric_lines(sout)
+    assert dp_vals.keys() == s_vals.keys() and len(dp_vals) > 0
+    for key in s_vals:
+        np.testing.assert_allclose(dp_vals[key], s_vals[key],
+                                   rtol=2e-5, atol=1e-7,
+                                   err_msg=f"metric {key}")
+
+
+def test_two_process_dp_weighted_regression_matches_serial(tmp_path):
+    """Multi-process DP L2 regression with row weights (a .weight side
+    file, sharded with the rows): worker-identical, serial-identical
+    trees; weighted l2 metric trajectory equal to serial."""
+    rng = np.random.RandomState(29)
+    n, f = 1600, 6
+    x = rng.randn(n, f)
+    y = x[:, 0] * 2.0 - x[:, 1] + 0.3 * rng.randn(n)
+    csv = str(tmp_path / "train.csv")
+    np.savetxt(csv, np.column_stack([y, x]), fmt="%.7g", delimiter=",")
+    np.savetxt(csv + ".weight", (0.5 + rng.rand(n)).astype(np.float32),
+               fmt="%.5f")
+    extra = "metric=l2\nis_training_metric=true\n"
+
+    port = _free_port()
+    procs = []
+    for rank in range(2):
+        conf = str(tmp_path / f"train_r{rank}.conf")
+        _write_conf(conf, csv, str(tmp_path / f"model_r{rank}.txt"),
+                    "data", 2, extra=extra, metric_freq=1,
+                    objective="regression")
+        procs.append(_run(conf, extra_env={
+            "LGBM_TPU_COORDINATOR": f"127.0.0.1:{port}",
+            "LGBM_TPU_NUM_PROCS": "2",
+            "LGBM_TPU_PROC_ID": str(rank),
+        }))
+    outs = [p.communicate(timeout=900)[0] for p in procs]
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out[-4000:]}"
+        assert "POST process_count: 2" in out
+
+    sconf = str(tmp_path / "train_serial.conf")
+    _write_conf(sconf, csv, str(tmp_path / "model_serial.txt"),
+                "serial", 1, extra=extra, metric_freq=1,
+                objective="regression")
+    sp = _run(sconf)
+    sout, _ = sp.communicate(timeout=900)
+    assert sp.returncode == 0, f"serial failed:\n{sout[-4000:]}"
+
+    m0 = open(tmp_path / "model_r0.txt").read()
+    m1 = open(tmp_path / "model_r1.txt").read()
+    assert m0 == m1, "workers diverged"
+    trees_dp = _load_trees(str(tmp_path / "model_r0.txt"))
+    trees_s = _load_trees(str(tmp_path / "model_serial.txt"))
+    assert len(trees_dp) == len(trees_s) == 8
+    for i, (td, ts) in enumerate(zip(trees_dp, trees_s)):
+        np.testing.assert_array_equal(td.split_feature, ts.split_feature,
+                                      err_msg=f"tree {i}")
+        np.testing.assert_array_equal(td.threshold_bin, ts.threshold_bin,
+                                      err_msg=f"tree {i}")
+    dp_vals = _parse_metric_lines(outs[0])
+    s_vals = _parse_metric_lines(sout)
+    assert dp_vals.keys() == s_vals.keys() and len(dp_vals) > 0
+    for key in s_vals:
+        np.testing.assert_allclose(dp_vals[key], s_vals[key],
+                                   rtol=2e-5, atol=1e-7,
+                                   err_msg=f"metric {key}")
