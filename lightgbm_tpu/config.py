@@ -266,6 +266,12 @@ class TreeConfig:
     # 1/S of the split-search compute per level.  Applies to the fused
     # depthwise data-parallel chunk; identical trees either way.
     dp_schedule: str = "psum"
+    # int8 rounding mode: "nearest" (default) or "stochastic" — unbiased
+    # floor(y+u) with deterministic value-keyed uniform bits
+    # (ops/hist_pallas.stochastic_bits); preserves the serial==distributed
+    # bit-identity because the key is the row's (grad, hess) values, not
+    # its position
+    quant_rounding: str = "nearest"
 
     def set(self, params: Dict[str, str]) -> None:
         self.min_data_in_leaf = _get_int(params, "min_data_in_leaf", self.min_data_in_leaf)
@@ -302,6 +308,15 @@ class TreeConfig:
             log.check(value in ("psum", "reduce_scatter"),
                       "dp_schedule must be psum or reduce_scatter")
             self.dp_schedule = value
+        if "quant_rounding" in params:
+            value = params["quant_rounding"].lower()
+            log.check(value in ("nearest", "stochastic"),
+                      "quant_rounding must be nearest or stochastic")
+            self.quant_rounding = value
+            if value == "stochastic" and self.hist_dtype != "int8":
+                log.warning("quant_rounding=stochastic only applies to "
+                            "hist_dtype=int8; ignored for %s"
+                            % self.hist_dtype)
 
 
 @dataclasses.dataclass

@@ -645,6 +645,7 @@ class GBDT:
                 max_depth=self.tree_config.max_depth,
                 hist_chunk=self.tree_config.hist_chunk,
                 hist_dtype=self.tree_config.hist_dtype,
+                quant_rounding=self.tree_config.quant_rounding,
                 has_bag=has_bag, has_ff=has_ff,
                 train_metric_fns=tuple(s[2] for s in train_specs),
                 valid_metric_fns=tuple(tuple(s[2] for s in specs)
@@ -1304,12 +1305,14 @@ def _get_chunk_program(obj_key, grad_fn, num_class: int, lr: float,
                        num_bins_max: int, min_data_in_leaf: int,
                        min_sum_hessian_in_leaf: float, max_depth: int,
                        hist_chunk: int = 0, hist_dtype: str = "float32",
+                       quant_rounding: str = "nearest",
                        has_bag: bool, has_ff: bool,
                        train_metric_fns: tuple = (),
                        valid_metric_fns: tuple = ()):
     key = (obj_key, id(grad_fn), num_class, lr, grow_policy, num_leaves,
            num_bins_max, min_data_in_leaf, min_sum_hessian_in_leaf,
-           max_depth, hist_chunk, hist_dtype, has_bag, has_ff,
+           max_depth, hist_chunk, hist_dtype, quant_rounding, has_bag,
+           has_ff,
            tuple(id(f) for f in train_metric_fns),
            tuple(tuple(id(f) for f in fns) for fns in valid_metric_fns))
     prog = _CHUNK_PROGRAMS.get(key)
@@ -1320,7 +1323,7 @@ def _get_chunk_program(obj_key, grad_fn, num_class: int, lr: float,
         num_leaves=num_leaves, num_bins_max=num_bins_max,
         min_data_in_leaf=min_data_in_leaf,
         min_sum_hessian_in_leaf=min_sum_hessian_in_leaf, max_depth=max_depth,
-        **_tuning_kwargs(hist_chunk, hist_dtype))
+        **_tuning_kwargs(hist_chunk, hist_dtype, quant_rounding))
     if grow_policy == "depthwise":
         from .grower_depthwise import grow_tree_depthwise as grow
     else:
@@ -1348,7 +1351,8 @@ def _get_chunk_program(obj_key, grad_fn, num_class: int, lr: float,
     return prog
 
 
-def _tuning_kwargs(hist_chunk: int, hist_dtype: str) -> dict:
+def _tuning_kwargs(hist_chunk: int, hist_dtype: str,
+                   quant_rounding: str = "nearest") -> dict:
     """Grower kwargs for the TPU tuning knobs (TreeConfig extensions)."""
     kwargs = {}
     if hist_chunk > 0:
@@ -1357,8 +1361,11 @@ def _tuning_kwargs(hist_chunk: int, hist_dtype: str) -> dict:
         kwargs["compute_dtype"] = jnp.bfloat16
     elif hist_dtype == "int8":
         # string sentinel (hashable jit static): quantized-gradient path,
-        # dispatched per backend in the histogram ops
-        kwargs["compute_dtype"] = "int8"
+        # dispatched per backend in the histogram ops; the "_sr" variant
+        # rounds stochastically (unbiased, value-keyed bits)
+        kwargs["compute_dtype"] = ("int8_sr"
+                                   if quant_rounding == "stochastic"
+                                   else "int8")
     return kwargs
 
 
@@ -1372,7 +1379,8 @@ def _serial_learner(gbdt: GBDT, bins, grad, hess, row_mask, feature_mask):
         min_sum_hessian_in_leaf=gbdt.tree_config.min_sum_hessian_in_leaf,
         max_depth=gbdt.tree_config.max_depth,
         **_tuning_kwargs(gbdt.tree_config.hist_chunk,
-                         gbdt.tree_config.hist_dtype))
+                         gbdt.tree_config.hist_dtype,
+                         gbdt.tree_config.quant_rounding))
     if gbdt.tree_config.grow_policy == "depthwise":
         from .grower_depthwise import grow_tree_depthwise_jit
         return grow_tree_depthwise_jit(bins, grad, hess, row_mask,

@@ -134,15 +134,16 @@ def grow_tree_impl(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     if partition_bins is None:
         partition_bins = bins
 
-    def hist_of(mask):
+    def hist_of(mask, salt=0):
         hist = build_histogram(bins, grad, hess, mask, B,
                                backend=hist_backend, chunk=hist_chunk,
                                compute_dtype=compute_dtype,
-                               axis_name=hist_axis)
+                               axis_name=hist_axis, salt=salt)
         # the quantized path reduces its INT accumulators internally over
         # hist_axis (bit-exactness; ops/hist_pallas.quantize_values)
         if hist_reduce is not None and not (
-                compute_dtype == "int8" and hist_axis is not None):
+                str(compute_dtype).startswith("int8")
+                and hist_axis is not None):
             hist = hist_reduce(hist)
         return hist
 
@@ -158,7 +159,7 @@ def grow_tree_impl(bins: jax.Array, grad: jax.Array, hess: jax.Array,
 
     # ---- root init (BeforeTrain, serial_tree_learner.cpp:155-236)
     root_hist = hist_of(row_mask)
-    if compute_dtype == "int8":
+    if str(compute_dtype).startswith("int8"):
         # quantized mode: derive root stats from the histogram — the int
         # accumulators are bit-identical across serial/data-parallel (see
         # grower_depthwise.py root-stat note), and any feature's bins sum
@@ -261,7 +262,9 @@ def grow_tree_impl(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             left_is_smaller = lcnt <= rcnt
             small_leaf = jnp.where(left_is_smaller, bl, new_leaf)
             small_mask = row_mask & (leaf_ids == small_leaf)
-            small_hist = hist_of(small_mask)
+            # salt = the new leaf index: varies per split pass so the
+            # stochastic-rounding bits decorrelate across passes
+            small_hist = hist_of(small_mask, salt=new_leaf)
             parent_hist = state.hist_cache[bl]
             large_hist = parent_hist - small_hist
             lhist = jnp.where(left_is_smaller, small_hist, large_hist)

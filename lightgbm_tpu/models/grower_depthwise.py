@@ -105,13 +105,16 @@ def grow_tree_depthwise(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     mind = float(min_data_in_leaf)
     minh = float(min_sum_hessian_in_leaf)
 
-    def batch_hist_rows(b, g, h, col_id, col_ok, C, level=False):
+    def batch_hist_rows(b, g, h, col_id, col_ok, C, level=False, salt=0):
         # level passes may use the scatter schedule; the root pass always
         # reduces in full
         int_red = int_reduce_level if level else None
-        # forward int_reduce only when set: drop-in replacements
-        # (histogram_leafbatch_segsum, test/profiling stubs) don't take it
+        # forward optional kwargs only when set: drop-in replacements
+        # (histogram_leafbatch_segsum, test/profiling stubs) don't take
+        # them
         extra = {"int_reduce": int_red} if int_red is not None else {}
+        if salt and compute_dtype == "int8_sr":
+            extra["salt"] = salt
         out = histogram_leafbatch(b, g, h, col_id, col_ok, C, B,
                                   chunk=hist_chunk,
                                   compute_dtype=compute_dtype,
@@ -119,16 +122,16 @@ def grow_tree_depthwise(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         # the quantized path reduces its INT accumulators internally over
         # hist_axis (bit-exactness); applying hist_reduce again would
         # double-count
-        if compute_dtype == "int8" and hist_axis is not None:
+        if str(compute_dtype).startswith("int8") and hist_axis is not None:
             return out
         red = (hist_reduce_level or hist_reduce) if level else hist_reduce
         if red is not None:
             out = red(out)
         return out
 
-    def batch_hist(col_id, col_ok, C, level=False):
+    def batch_hist(col_id, col_ok, C, level=False, salt=0):
         return batch_hist_rows(bins, grad, hess, col_id, col_ok, C,
-                               level=level)
+                               level=level, salt=salt)
 
     vsplit = jax.vmap(split_finder or find_best_split,
                       in_axes=(0, 0, 0, 0, None, None, None, None))
@@ -137,7 +140,7 @@ def grow_tree_depthwise(bins: jax.Array, grad: jax.Array, hess: jax.Array,
 
     # ---- root (BeforeTrain: serial_tree_learner.cpp:155-236)
     hists = batch_hist(jnp.zeros((N,), i32), row_mask, 1)  # [1, F, B, 3]
-    if compute_dtype == "int8":
+    if str(compute_dtype).startswith("int8"):
         # derive root stats from the root histogram: the quantized hist is
         # bit-identical across serial / data-parallel / multi-process (the
         # scale is pmax-synced and int32 sums are order-free), so this
@@ -319,7 +322,7 @@ def grow_tree_depthwise(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         # reference's per-leaf index lists, data_partition.hpp) costs more
         # in cumsum/scatter/gather plumbing than the halved histogram pass
         # saves — see git history for the removed compaction path.
-        hist_small = batch_hist(par_of_row, sel, P, level=True)
+        hist_small = batch_hist(par_of_row, sel, P, level=True, salt=d + 1)
         hist_large = hists - hist_small
         hsmall_slot = interleave(jnp.where(small_is_right[:, None, None, None],
                                            hist_large, hist_small),

@@ -115,12 +115,46 @@ def hist_pallas_raw(bins, packed, *, B: int, chunk: int = 2048,
     return out.astype(jnp.int32)
 
 
-def quantize_values(grad, hess, col_ok, rng_bits=None, axis_name=None):
+def _mix32(x):
+    """murmur3-style integer finalizer (public-domain mixing constants):
+    a stateless uint32 hash good enough to decorrelate rounding noise."""
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(0xC2B2AE35)
+    return x ^ (x >> 16)
+
+
+def stochastic_bits(x, other, salt):
+    """Deterministic per-element uniform bits for stochastic rounding,
+    keyed on the (grad, hess) VALUE PAIR of the row and a per-use
+    ``salt``.  Value-keyed means no row-position plumbing: the same
+    physical row carries the same gradient bits in serial, sharded and
+    multi-process programs alike — regardless of row position in the
+    padded layouts — so the serial == distributed bit-identity of the
+    int8 histograms survives, and the key varies per boosting iteration
+    automatically because the gradients do.  Rows sharing the exact
+    (grad, hess) pair round identically (iteration 0's uniform hessians
+    are the worst case — but there grad/hess quantize near-exactly by
+    construction of the per-pass max scale); from iteration 1 on the
+    score fan-out makes the pairs effectively unique per row."""
+    ix = jax.lax.bitcast_convert_type(x.astype(jnp.float32), jnp.uint32)
+    io = jax.lax.bitcast_convert_type(other.astype(jnp.float32),
+                                      jnp.uint32)
+    return _mix32(ix ^ _mix32(io)
+                  ^ _mix32(jnp.uint32(salt) + jnp.uint32(0x9E3779B9)))
+
+
+def quantize_values(grad, hess, col_ok, rng_bits=None, axis_name=None,
+                    stochastic=False, salt=0):
     """int8 quantization of grad/hess with a per-pass global scale.
 
-    Round-to-nearest by default; unbiased stochastic rounding (floor(y+u))
-    when ``rng_bits`` [2, N] uint32 is given.  Returns (vals [3, N] int8
-    lane-major, scale [3] f32) — the count row is exact by construction.
+    Round-to-nearest by default; unbiased stochastic rounding
+    (floor(y+u), u uniform in [0,1)) with ``stochastic=True`` — the
+    uniform bits come from a deterministic value-keyed hash
+    (``stochastic_bits``), or from explicit ``rng_bits`` [2, N] uint32.
+    Returns (vals [3, N] int8 lane-major, scale [3] f32) — the count row
+    is exact by construction.
 
     ``axis_name``: under shard_map, pmax the scale over the data axis so
     every shard quantizes identically — int32 accumulation is then
@@ -151,8 +185,14 @@ def quantize_values(grad, hess, col_ok, rng_bits=None, axis_name=None):
             q = jnp.floor(y + u)
         return jnp.clip(q, -127, 127)
 
-    gq = quant(grad, gs, None if rng_bits is None else rng_bits[0])
-    hq = quant(hess, hs, None if rng_bits is None else rng_bits[1])
+    gbits = hbits = None
+    if rng_bits is not None:
+        gbits, hbits = rng_bits[0], rng_bits[1]
+    elif stochastic:
+        gbits = stochastic_bits(grad, hess, salt)
+        hbits = stochastic_bits(hess, grad, salt + 0x51ED)
+    gq = quant(grad, gs, gbits)
+    hq = quant(hess, hs, hbits)
     vals = jnp.stack([gq * okf, hq * okf, okf], axis=0).astype(jnp.int8)
     return vals, jnp.stack([gs, hs, jnp.float32(1.0)])
 
@@ -175,7 +215,8 @@ def _grouped(fn, bins, grad, hess, col_id, col_ok, num_cols, B, **kw):
 def hist_pallas_leafbatch(bins, grad, hess, col_id, col_ok, num_cols: int,
                           num_bins_max: int, *, chunk: int = 2048,
                           dtype: str = "int8", rng_bits=None,
-                          axis_name=None, int_reduce=None):
+                          axis_name=None, int_reduce=None,
+                          stochastic=False, salt=0):
     """Drop-in histogram_leafbatch equivalent on the Pallas kernel.
 
     ``bins`` is the usual [F, N] matrix (int8 or uint8).  The int32
@@ -187,7 +228,8 @@ def hist_pallas_leafbatch(bins, grad, hess, col_id, col_ok, num_cols: int,
         return _hist_pallas_one(bins, grad, hess, col_id, col_ok, num_cols,
                                 num_bins_max, chunk=chunk, dtype=dtype,
                                 rng_bits=rng_bits, axis_name=axis_name,
-                                int_reduce=int_reduce)
+                                int_reduce=int_reduce,
+                                stochastic=stochastic, salt=salt)
     n_groups = -(-num_cols // 64)
     width = -(-num_cols // n_groups)
     parts = []
@@ -197,17 +239,19 @@ def hist_pallas_leafbatch(bins, grad, hess, col_id, col_ok, num_cols: int,
         parts.append(_hist_pallas_one(
             bins, grad, hess, col_id - base, ok, k, num_bins_max,
             chunk=chunk, dtype=dtype, rng_bits=rng_bits,
-            axis_name=axis_name, int_reduce=int_reduce))
+            axis_name=axis_name, int_reduce=int_reduce,
+            stochastic=stochastic, salt=salt))
     return jnp.concatenate(parts, axis=0)
 
 
 def _hist_pallas_one(bins, grad, hess, col_id, col_ok, num_cols, B, *,
                      chunk, dtype, rng_bits, axis_name=None,
-                     int_reduce=None):
+                     int_reduce=None, stochastic=False, salt=0):
     F, N = bins.shape
     lanes = LANES if num_cols <= 42 else 192
     vals, scale = quantize_values(grad, hess, col_ok, rng_bits,
-                                  axis_name=axis_name)
+                                  axis_name=axis_name,
+                                  stochastic=stochastic, salt=salt)
     cid8 = jnp.where(col_ok, col_id, -1).astype(jnp.int8)
     packed = jnp.concatenate([vals, cid8[None, :]], axis=0)  # [4, N] int8
 
@@ -235,23 +279,27 @@ def _hist_pallas_one(bins, grad, hess, col_id, col_ok, num_cols, B, *,
 
 def hist_quant_xla(bins, grad, hess, col_id, col_ok, num_cols: int,
                    num_bins_max: int, *, chunk: int = 65536, rng_bits=None,
-                   axis_name=None, int_reduce=None):
+                   axis_name=None, int_reduce=None,
+                   stochastic=False, salt=0):
     """XLA reference of the SAME quantized-gradient math as the Pallas int8
     kernel (bit-identical output) — the CPU-testable oracle and the
     fallback on non-TPU backends."""
     return _grouped(_hist_quant_xla_one, bins, grad, hess, col_id, col_ok,
                     num_cols, num_bins_max, chunk=chunk, rng_bits=rng_bits,
-                    axis_name=axis_name, int_reduce=int_reduce)
+                    axis_name=axis_name, int_reduce=int_reduce,
+                    stochastic=stochastic, salt=salt)
 
 
 def _hist_quant_xla_one(bins, grad, hess, col_id, col_ok, num_cols, B, *,
-                        chunk, rng_bits, axis_name=None, int_reduce=None):
+                        chunk, rng_bits, axis_name=None, int_reduce=None,
+                        stochastic=False, salt=0):
     F, N = bins.shape
     C = num_cols
     # don't pad a small input up to a full default chunk
     chunk = min(chunk, max(256, -(-N // 256) * 256))
     vals, scale = quantize_values(grad, hess, col_ok, rng_bits,
-                                  axis_name=axis_name)
+                                  axis_name=axis_name,
+                                  stochastic=stochastic, salt=salt)
     cid = jnp.where(col_ok, col_id, -1).astype(jnp.int32)
     pad = (-N) % chunk
     if pad:

@@ -105,7 +105,8 @@ def histogram_leafbatch(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                         col_id: jax.Array, col_ok: jax.Array, num_cols: int,
                         num_bins_max: int, chunk: int = 65536,
                         compute_dtype=jnp.bfloat16,
-                        axis_name=None, int_reduce=None) -> jax.Array:
+                        axis_name=None, int_reduce=None,
+                        salt=0) -> jax.Array:
     """Build histograms for MANY leaves in ONE matmul pass.
 
     The single-leaf one-hot matmul starves the MXU: the value operand has
@@ -127,12 +128,14 @@ def histogram_leafbatch(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     -------
     hist : [C, F, B, 3] f32
     """
-    if compute_dtype == "int8":
+    if str(compute_dtype).startswith("int8"):
         # quantized-gradient path: Pallas int8-MXU kernel on TPU, the
         # bit-identical XLA formulation elsewhere (ops/hist_pallas.py).
         # The Pallas kernel carries bins as int8 bit-patterns, so bin ids
         # must fit 8 bits — max_bin > 256 datasets (int16 bins) take the
-        # XLA int formulation instead.
+        # XLA int formulation instead.  "int8_sr" = unbiased stochastic
+        # rounding (value-keyed deterministic bits).
+        stochastic = compute_dtype == "int8_sr"
         import jax as _jax
         from .hist_pallas import hist_pallas_leafbatch, hist_quant_xla
         # the Pallas kernel pins the whole [F, B, lanes] int32 accumulator
@@ -147,10 +150,12 @@ def histogram_leafbatch(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             return hist_pallas_leafbatch(bins, grad, hess, col_id, col_ok,
                                          num_cols, num_bins_max,
                                          axis_name=axis_name,
-                                         int_reduce=int_reduce)
+                                         int_reduce=int_reduce,
+                                         stochastic=stochastic, salt=salt)
         return hist_quant_xla(bins, grad, hess, col_id, col_ok, num_cols,
                               num_bins_max, chunk=chunk,
-                              axis_name=axis_name, int_reduce=int_reduce)
+                              axis_name=axis_name, int_reduce=int_reduce,
+                              stochastic=stochastic, salt=salt)
     F, N = bins.shape
     B = num_bins_max
     # cap the pass at ONE 128-lane tile of the value operand (42 histogram
@@ -220,7 +225,7 @@ def histogram_leafbatch(bins: jax.Array, grad: jax.Array, hess: jax.Array,
 def histogram_leafbatch_segsum(bins, grad, hess, col_id, col_ok,
                                num_cols: int, num_bins_max: int,
                                chunk: int = 0, compute_dtype=None,
-                               axis_name=None):
+                               axis_name=None, int_reduce=None, salt=0):
     """Scatter-add leaf-batched histogram — CPU-fast oracle with the same
     [C, F, B, 3] contract as histogram_leafbatch (scatter beats the dense
     one-hot matmul off-TPU; summation ORDER differs, so f32 sums match the
@@ -241,7 +246,8 @@ def histogram_leafbatch_segsum(bins, grad, hess, col_id, col_ok,
 
 def hist_quant_segsum(bins, grad, hess, col_id, col_ok, num_cols: int,
                       num_bins_max: int, chunk: int = 0, rng_bits=None,
-                      compute_dtype=None, axis_name=None):
+                      compute_dtype=None, axis_name=None, int_reduce=None,
+                      salt=0):
     """Scatter-add variant of the quantized-gradient histogram — exact
     int32 accumulation, so it is bit-identical to hist_pallas/hist_quant_xla
     (ops/hist_pallas.py) at any summation order; the CPU-fast oracle for
@@ -251,7 +257,9 @@ def hist_quant_segsum(bins, grad, hess, col_id, col_ok, num_cols: int,
     B = num_bins_max
     C = num_cols
     vals, scale = quantize_values(grad, hess, col_ok, rng_bits,
-                                  axis_name=axis_name)      # [3, N] i8
+                                  axis_name=axis_name,
+                                  stochastic=(compute_dtype == "int8_sr"),
+                                  salt=salt)                # [3, N] i8
     cid = jnp.where(col_ok, col_id, C).astype(jnp.int32)
     ids = (cid[None, :] * F + jnp.arange(F, dtype=jnp.int32)[:, None]) * B \
         + bins.astype(jnp.int32)
@@ -281,14 +289,16 @@ def histogram_segsum(bins: jax.Array, grad: jax.Array, hess: jax.Array,
 
 def build_histogram(bins, grad, hess, mask, num_bins_max, *,
                     backend: str = "matmul", chunk: int = 16384,
-                    compute_dtype=jnp.float32, axis_name=None) -> jax.Array:
-    if compute_dtype == "int8":
+                    compute_dtype=jnp.float32, axis_name=None,
+                    salt=0) -> jax.Array:
+    if str(compute_dtype).startswith("int8"):
         # single-leaf quantized pass == leaf-batched with one column
         N = bins.shape[1]
         cid = jnp.zeros((N,), jnp.int32)
         out = histogram_leafbatch(bins, grad, hess, cid, mask, 1,
                                   num_bins_max, chunk=chunk,
-                                  compute_dtype="int8", axis_name=axis_name)
+                                  compute_dtype=compute_dtype,
+                                  axis_name=axis_name, salt=salt)
         return out[0]
     if backend == "matmul":
         return histogram_matmul(bins, grad, hess, mask, num_bins_max,

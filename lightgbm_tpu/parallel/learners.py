@@ -109,7 +109,8 @@ class _ParallelLearnerBase:
             min_sum_hessian_in_leaf=self.tree_config.min_sum_hessian_in_leaf,
             max_depth=self.tree_config.max_depth,
             **_tuning_kwargs(self.tree_config.hist_chunk,
-                             self.tree_config.hist_dtype))
+                             self.tree_config.hist_dtype,
+                             self.tree_config.quant_rounding))
 
     @property
     def _depthwise(self) -> bool:

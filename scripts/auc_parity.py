@@ -57,6 +57,8 @@ def main() -> int:
                     choices=["depthwise", "leafwise"])
     ap.add_argument("--hist-dtype", default="float32",
                     choices=["float32", "bfloat16", "int8"])
+    ap.add_argument("--quant-rounding", default="nearest",
+                    choices=["nearest", "stochastic"])
     args = ap.parse_args()
 
     x, y = make_data(args.rows + args.test_rows, 28)
@@ -80,21 +82,31 @@ def main() -> int:
     cfg.set({**{k: str(v) for k, v in conf_common.items()},
              "num_iterations": str(args.iters),
              "hist_dtype": args.hist_dtype,
+             "quant_rounding": args.quant_rounding,
              "grow_policy": args.grow_policy}, require_data=False)
     booster = GBDT()
     booster.init(cfg.boosting_config, ds,
                  create_objective(cfg.objective_type, cfg.objective_config))
     t0 = time.time()
-    done = 0
-    while done < args.iters:
-        k = min(64, args.iters - done)
-        booster.train_chunk(k)
-        done += k
+    if args.grow_policy == "leafwise":
+        # leaf-wise runs per-iteration: a fused chunk is ONE dispatch of
+        # k x 254 histogram passes and crosses the environment's ~60 s
+        # per-dispatch watchdog (BASELINE.md; same rule as bench.py)
+        for _ in range(args.iters):
+            if booster.train_one_iter(is_eval=False):
+                break
+    else:
+        done = 0
+        while done < args.iters:
+            k = min(64, args.iters - done)
+            booster.train_chunk(k)
+            done += k
     jax.block_until_ready(booster.score)
     t_ours = time.time() - t0
     ours_scores = booster.predict_raw(xte)
     ours_auc = auc_manual(yte, ours_scores)
-    print(f"ours[{args.grow_policy}/{args.hist_dtype}]: "
+    print(f"ours[{args.grow_policy}/{args.hist_dtype}/"
+          f"{args.quant_rounding}]: "
           f"{args.iters} iters in {t_ours:.1f}s "
           f"wall incl. jit compile (bench.py reports steady-state "
           f"throughput), test AUC {ours_auc:.6f}", flush=True)
@@ -104,7 +116,10 @@ def main() -> int:
         print("reference binary not built; skipping reference side")
         return 0
     import pandas as pd
-    tr_csv, te_csv = "/tmp/parity_train.csv", "/tmp/parity_test.csv"
+    import tempfile
+    # unique workdir: concurrent invocations must not clobber each other
+    wd = tempfile.mkdtemp(prefix="auc_parity_")
+    tr_csv, te_csv = f"{wd}/train.csv", f"{wd}/test.csv"
     pd.DataFrame(np.column_stack([ytr, xtr])).to_csv(
         tr_csv, index=False, header=False, float_format="%.7g")
     pd.DataFrame(np.column_stack([yte, xte])).to_csv(
@@ -114,18 +129,20 @@ def main() -> int:
                      [f"{k}={v}" for k, v in conf_common.items()
                       if k != "num_trees"] +
                      ["metric_freq=1000", "is_training_metric=false",
-                      "output_model=/tmp/parity_model.txt"])
-    open("/tmp/parity_train.conf", "w").write(conf + "\n")
+                      f"output_model={wd}/parity_model.txt"])
+    open(f"{wd}/parity_train.conf", "w").write(conf + "\n")
     t0 = time.time()
-    subprocess.run([REF_BIN, "config=/tmp/parity_train.conf"], check=True,
+    subprocess.run([REF_BIN, f"config={wd}/parity_train.conf"], check=True,
                    capture_output=True, text=True)
     t_ref = time.time() - t0
-    open("/tmp/parity_pred.conf", "w").write(
-        f"task=predict\ndata={te_csv}\ninput_model=/tmp/parity_model.txt\n"
-        "output_result=/tmp/parity_pred.txt\nis_sigmoid=false\n")
-    subprocess.run([REF_BIN, "config=/tmp/parity_pred.conf"], check=True,
+    open(f"{wd}/parity_pred.conf", "w").write(
+        f"task=predict\ndata={te_csv}\ninput_model={wd}/parity_model.txt\n"
+        f"output_result={wd}/parity_pred.txt\nis_sigmoid=false\n")
+    subprocess.run([REF_BIN, f"config={wd}/parity_pred.conf"], check=True,
                    capture_output=True, text=True)
-    ref_scores = np.loadtxt("/tmp/parity_pred.txt")
+    ref_scores = np.loadtxt(f"{wd}/parity_pred.txt")
+    import shutil
+    shutil.rmtree(wd, ignore_errors=True)   # ~300+ MB of CSVs per run
     ref_auc = auc_manual(yte, ref_scores)
     print(f"reference: {args.iters} iters in {t_ref:.1f}s "
           f"({args.iters / t_ref:.2f} iters/s), test AUC {ref_auc:.6f}",

@@ -172,3 +172,86 @@ def test_int8_row_capacity_guard():
     check_int8_row_capacity(11_000_000)               # bench scale: fine
     with pytest.raises(LightGBMError):
         check_int8_row_capacity(INT8_HIST_MAX_ROWS + 1)
+
+
+def test_stochastic_rounding_unbiased_and_deterministic():
+    """quant_rounding=stochastic: value-keyed bits make rounding unbiased
+    in expectation over many distinct values (mean quantization error well
+    below the half-quantum bias a floor/ceil would give) and fully
+    deterministic (same inputs -> same bits -> same ints)."""
+    from lightgbm_tpu.ops.hist_pallas import quantize_values
+    rng = np.random.RandomState(0)
+    n = 200_000
+    grad = rng.randn(n).astype(np.float32)
+    hess = (0.1 + rng.rand(n)).astype(np.float32)
+    ok = np.ones(n, bool)
+    v1, s1 = quantize_values(jnp.asarray(grad), jnp.asarray(hess),
+                             jnp.asarray(ok), stochastic=True, salt=7)
+    v2, s2 = quantize_values(jnp.asarray(grad), jnp.asarray(hess),
+                             jnp.asarray(ok), stochastic=True, salt=7)
+    np.testing.assert_array_equal(np.asarray(v1), np.asarray(v2))
+
+    # unbiasedness: the mean signed quantization error of the SUM is tiny
+    # relative to the one-ulp-per-row worst case
+    gs = float(np.asarray(s1)[0])
+    err = np.asarray(v1)[0].astype(np.float64) * gs - grad
+    assert abs(err.mean()) < 0.02 * gs   # nearest-rounding is also ~0; the
+    # distinguishing property is variance behavior, checked via the sum:
+    assert abs(err.sum()) < 3 * gs * np.sqrt(n)
+
+    # different salt -> different rounding realization (not a constant fn)
+    v3, _ = quantize_values(jnp.asarray(grad), jnp.asarray(hess),
+                            jnp.asarray(ok), stochastic=True, salt=8)
+    assert (np.asarray(v3)[0] != np.asarray(v1)[0]).any()
+
+
+def test_stochastic_int8_dp_bit_identical_to_serial():
+    """The stochastic bits are keyed on the row's (grad, hess) VALUES, not
+    its position — so serial and data-parallel programs quantize every
+    physical row identically and the int8 bit-identity chain survives
+    (both dp_schedule variants)."""
+    from lightgbm_tpu.config import OverallConfig
+    from lightgbm_tpu.io.dataset import Dataset
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.objectives import create_objective
+
+    rng = np.random.RandomState(3)
+    n, f = 1999, 8
+    x = rng.randn(n, f)
+    y = ((x[:, 0] - 0.5 * x[:, 1] + 0.4 * rng.randn(n)) > 0).astype(
+        np.float32)
+    ds = Dataset.from_arrays(x, y, max_bin=32)
+    params = {"objective": "binary", "num_leaves": 15,
+              "min_data_in_leaf": 20, "min_sum_hessian_in_leaf": 1.0,
+              "num_iterations": 4, "learning_rate": 0.2,
+              "grow_policy": "depthwise", "hist_dtype": "int8",
+              "quant_rounding": "stochastic"}
+
+    def make(tree_learner, machines, schedule="psum"):
+        cfg = OverallConfig()
+        p = dict(params, tree_learner=tree_learner, num_machines=machines,
+                 dp_schedule=schedule)
+        cfg.set({k: str(v) for k, v in p.items()}, require_data=False)
+        b = GBDT()
+        obj = create_objective(cfg.objective_type, cfg.objective_config)
+        learner = None
+        if tree_learner != "serial":
+            from lightgbm_tpu.parallel import create_parallel_learner
+            learner = create_parallel_learner(cfg)
+        b.init(cfg.boosting_config, ds, obj, learner=learner)
+        return b
+
+    bs = make("serial", 1)
+    for _ in range(4):
+        bs.train_one_iter(is_eval=False)
+    for sched in ("psum", "reduce_scatter"):
+        bd = make("data", 8, sched)
+        bd.train_chunk(4)
+        for k, (t1, t2) in enumerate(zip(bs.models, bd.models)):
+            assert t1.num_leaves == t2.num_leaves, (sched, k)
+            np.testing.assert_array_equal(t1.split_feature,
+                                          t2.split_feature,
+                                          err_msg=f"{sched} tree {k}")
+            np.testing.assert_array_equal(t1.threshold_bin,
+                                          t2.threshold_bin,
+                                          err_msg=f"{sched} tree {k}")
