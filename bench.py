@@ -117,8 +117,9 @@ def main() -> int:
             and args.grow_policy == "depthwise"):
         # one fused dispatch of --iters f32 iterations at this scale would
         # cross the environment's ~60 s per-dispatch execution watchdog
-        # (BASELINE.md); clamp to a safe chunk length
-        safe = max(1, int(40.0 / (args.rows * 1.35e-7)))
+        # (BASELINE.md); clamp to a safe chunk length (coefficient = the
+        # measured f32x2 Pallas per-row-per-iteration cost)
+        safe = max(1, int(40.0 / (args.rows * 1.8e-7)))
         if args.iters > safe:
             print(f"clamping --iters {args.iters} -> {safe} "
                   f"(f32 dispatch watchdog, see BASELINE.md)",
@@ -144,8 +145,7 @@ def main() -> int:
         """Train one configuration (fresh booster, shared dataset) and
         return timed iters/sec: one warmup round compiles + caches the
         programs, one identical round is timed."""
-        cfg = OverallConfig()
-        cfg.set({
+        params = {
             "objective": "binary",
             "num_leaves": str(args.leaves),
             "min_data_in_leaf": "100",
@@ -155,7 +155,21 @@ def main() -> int:
             "hist_chunk": str(args.hist_chunk),
             "hist_dtype": hist_dtype,
             "num_iterations": str(2 * iters),
-        }, require_data=False)
+        }
+        if grow_policy == "leafwise":
+            # keep every leaf-wise dispatch under the environment's ~60 s
+            # execution watchdog: segment the per-tree split loop so each
+            # dispatch stays ~30 s (bit-identical trees,
+            # models/grower.grow_tree_segmented).  Coefficients = measured
+            # per-row-per-split pass cost on v5e per kernel (f32x2 is two
+            # bf16 passes, bfloat16 one, int8 one at 2x rate).
+            per_row = {"float32": 2.8e-8, "bfloat16": 1.5e-8,
+                       "int8": 9e-9}[hist_dtype]
+            split_s = args.rows * per_row
+            segs = max(1, math.ceil((args.leaves - 1) * split_s / 30.0))
+            params["leafwise_segments"] = str(segs)
+        cfg = OverallConfig()
+        cfg.set(params, require_data=False)
 
         booster = GBDT()
         objective = create_objective(cfg.objective_type,

@@ -266,6 +266,14 @@ class TreeConfig:
     # 1/S of the split-search compute per level.  Applies to the fused
     # depthwise data-parallel chunk; identical trees either way.
     dp_schedule: str = "psum"
+    # leaf-wise dispatch segmentation (TreeConfig extension, grow_policy=
+    # leafwise only): a 255-leaf leaf-wise tree is 254 sequential
+    # histogram passes in ONE XLA dispatch; >1 splits that loop across N
+    # dispatches with the grow state carried device-resident — bit-
+    # identical trees (models/grower.grow_tree_segmented), just shorter
+    # dispatches (runtime watchdogs, interactivity).  Default 1 = the
+    # whole tree in one dispatch.
+    leafwise_segments: int = 1
     # int8 rounding mode: "nearest" (default) or "stochastic" — unbiased
     # floor(y+u) with deterministic value-keyed uniform bits
     # (ops/hist_pallas.stochastic_bits); preserves the serial==distributed
@@ -303,6 +311,10 @@ class TreeConfig:
             log.check(value in ("float32", "bfloat16", "int8"),
                       "hist_dtype must be float32, bfloat16 or int8")
             self.hist_dtype = value
+        self.leafwise_segments = _get_int(params, "leafwise_segments",
+                                          self.leafwise_segments)
+        log.check(self.leafwise_segments >= 1,
+                  "leafwise_segments should be >= 1")
         if "dp_schedule" in params:
             value = params["dp_schedule"].lower()
             log.check(value in ("psum", "reduce_scatter"),

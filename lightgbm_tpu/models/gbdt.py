@@ -93,6 +93,15 @@ class GBDT:
         self.label_idx = train_data.label_idx
         self.sigmoid = objective.sigmoid if objective is not None else -1.0
         self._learner = learner or _serial_learner
+        if (learner is not None
+                and getattr(self.tree_config, "leafwise_segments", 1) > 1):
+            # the parallel learners drive grow_tree_impl inside their own
+            # (shard_map) programs; the dispatch-segmentation seam only
+            # exists on the serial path, so say so instead of silently
+            # running the whole tree as one dispatch
+            log.warning("leafwise_segments applies to the serial tree "
+                        "learner only; ignored for %s"
+                        % type(learner).__name__)
 
         N = train_data.num_data
         self.num_bins_max = int(train_data.num_bins.max())
@@ -1386,6 +1395,12 @@ def _serial_learner(gbdt: GBDT, bins, grad, hess, row_mask, feature_mask):
         return grow_tree_depthwise_jit(bins, grad, hess, row_mask,
                                        feature_mask, gbdt.num_bins_device,
                                        **kwargs)
+    segments = getattr(gbdt.tree_config, "leafwise_segments", 1)
+    if segments > 1:
+        from .grower import grow_tree_segmented
+        return grow_tree_segmented(
+            bins, grad, hess, row_mask, feature_mask, gbdt.num_bins_device,
+            segments=segments, **kwargs)
     return grow_tree(
         bins, grad, hess, row_mask, feature_mask, gbdt.num_bins_device,
         **kwargs)

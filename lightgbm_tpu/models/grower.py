@@ -99,7 +99,8 @@ def grow_tree_impl(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                    hist_chunk: int = 16384, compute_dtype=jnp.float32,
                    hist_reduce=None, hist_axis=None,
                    split_finder=None, partition_bins=None,
-                   stat_reduce=None) -> TreeArrays:
+                   stat_reduce=None, init_state=None, loop_count=None,
+                   return_state: bool = False):
     """Core grower (not jitted; callers wrap it).
 
     Parameters
@@ -125,6 +126,12 @@ def grow_tree_impl(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         (``bins``) but applies splits on the replicated full matrix, exactly
         like the reference where every worker holds all data and Split is
         local (feature_parallel_tree_learner.cpp:9-81)
+    init_state / loop_count / return_state : dispatch-segmentation seam
+        (grow_tree_segmented): resume from a carried _GrowState instead of
+        the root init, run only ``loop_count`` split attempts, and return
+        the full state so the caller can continue in a later dispatch.  The
+        body never reads the loop index, so splitting fori_loop(0, L-1)
+        into count-sized pieces is EXACTLY the same program.
     """
     F, N = bins.shape
     L = num_leaves
@@ -157,67 +164,71 @@ def grow_tree_impl(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             res = res._replace(gain=jnp.where(blocked, -jnp.inf, res.gain))
         return res
 
-    # ---- root init (BeforeTrain, serial_tree_learner.cpp:155-236)
-    root_hist = hist_of(row_mask)
-    if str(compute_dtype).startswith("int8"):
-        # quantized mode: derive root stats from the histogram — the int
-        # accumulators are bit-identical across serial/data-parallel (see
-        # grower_depthwise.py root-stat note), and any feature's bins sum
-        # to the same exact quantized totals, so this also holds under
-        # feature-parallel ownership slices
-        root_stats = jnp.sum(root_hist[0], axis=0)
-    else:
-        # root sums come from the gradient vectors, not from any one
-        # feature's histogram: per-feature f32 bin-order rounding would
-        # make the totals shard-dependent under feature-parallel ownership
-        # (the reference likewise computes root sums once from gradients,
-        # serial_tree_learner.cpp:178-198 / data_parallel root-sum
-        # allreduce)
-        maskf = row_mask.astype(f32)
-        root_stats = jnp.stack([jnp.sum(grad * maskf),
-                                jnp.sum(hess * maskf), jnp.sum(maskf)])
-        if stat_reduce is not None:
-            root_stats = stat_reduce(root_stats)
-    root_g, root_h, root_c = root_stats[0], root_stats[1], root_stats[2]
-    root_best = best_of(root_hist, root_g, root_h, root_c,
-                        jnp.asarray(1, jnp.int32))
+    # ---- root init (BeforeTrain, serial_tree_learner.cpp:155-236);
+    # skipped entirely when resuming from a carried state (segmentation)
+    def _root_state() -> _GrowState:
+        root_hist = hist_of(row_mask)
+        if str(compute_dtype).startswith("int8"):
+            # quantized mode: derive root stats from the histogram — the
+            # int accumulators are bit-identical across serial/
+            # data-parallel (see grower_depthwise.py root-stat note), and
+            # any feature's bins sum to the same exact quantized totals, so
+            # this also holds under feature-parallel ownership slices
+            root_stats = jnp.sum(root_hist[0], axis=0)
+        else:
+            # root sums come from the gradient vectors, not from any one
+            # feature's histogram: per-feature f32 bin-order rounding would
+            # make the totals shard-dependent under feature-parallel
+            # ownership (the reference likewise computes root sums once
+            # from gradients, serial_tree_learner.cpp:178-198 /
+            # data_parallel root-sum allreduce)
+            maskf = row_mask.astype(f32)
+            root_stats = jnp.stack([jnp.sum(grad * maskf),
+                                    jnp.sum(hess * maskf), jnp.sum(maskf)])
+            if stat_reduce is not None:
+                root_stats = stat_reduce(root_stats)
+        root_g, root_h, root_c = root_stats[0], root_stats[1], root_stats[2]
+        root_best = best_of(root_hist, root_g, root_h, root_c,
+                            jnp.asarray(1, jnp.int32))
 
-    neg_inf = jnp.full((L,), -jnp.inf, dtype=f32)
-    zeros_i = jnp.zeros((L,), dtype=jnp.int32)
-    zeros_f = jnp.zeros((L,), dtype=f32)
+        neg_inf = jnp.full((L,), -jnp.inf, dtype=f32)
+        zeros_i = jnp.zeros((L,), dtype=jnp.int32)
+        zeros_f = jnp.zeros((L,), dtype=f32)
 
-    tree = TreeArrays(
-        num_leaves=jnp.asarray(1, jnp.int32),
-        split_feature=jnp.zeros((L - 1,), jnp.int32),
-        threshold_bin=jnp.zeros((L - 1,), jnp.int32),
-        split_gain=jnp.zeros((L - 1,), f32),
-        left_child=jnp.zeros((L - 1,), jnp.int32),
-        right_child=jnp.zeros((L - 1,), jnp.int32),
-        leaf_parent=jnp.full((L,), -1, jnp.int32),
-        leaf_value=zeros_f,
-        leaf_count=zeros_i.at[0].set(root_c.astype(jnp.int32)),
-        leaf_ids=jnp.zeros((N,), jnp.int32),
-    )
-    state = _GrowState(
-        tree=tree,
-        hist_cache=jnp.zeros((L, F, B, 3), f32).at[0].set(root_hist),
-        cand_gain=neg_inf.at[0].set(root_best.gain),
-        cand_feature=zeros_i.at[0].set(root_best.feature),
-        cand_threshold=zeros_i.at[0].set(root_best.threshold),
-        cand_left_out=zeros_f.at[0].set(root_best.left_output),
-        cand_right_out=zeros_f.at[0].set(root_best.right_output),
-        cand_left_cnt=zeros_i.at[0].set(root_best.left_count),
-        cand_right_cnt=zeros_i.at[0].set(root_best.right_count),
-        cand_left_g=zeros_f.at[0].set(root_best.left_sum_grad),
-        cand_left_h=zeros_f.at[0].set(root_best.left_sum_hess),
-        cand_right_g=zeros_f.at[0].set(root_best.right_sum_grad),
-        cand_right_h=zeros_f.at[0].set(root_best.right_sum_hess),
-        leaf_sum_g=zeros_f.at[0].set(root_g),
-        leaf_sum_h=zeros_f.at[0].set(root_h),
-        leaf_cnt=zeros_i.at[0].set(root_c.astype(jnp.int32)),
-        leaf_depth=zeros_i.at[0].set(1),
-        done=jnp.asarray(False),
-    )
+        tree = TreeArrays(
+            num_leaves=jnp.asarray(1, jnp.int32),
+            split_feature=jnp.zeros((L - 1,), jnp.int32),
+            threshold_bin=jnp.zeros((L - 1,), jnp.int32),
+            split_gain=jnp.zeros((L - 1,), f32),
+            left_child=jnp.zeros((L - 1,), jnp.int32),
+            right_child=jnp.zeros((L - 1,), jnp.int32),
+            leaf_parent=jnp.full((L,), -1, jnp.int32),
+            leaf_value=zeros_f,
+            leaf_count=zeros_i.at[0].set(root_c.astype(jnp.int32)),
+            leaf_ids=jnp.zeros((N,), jnp.int32),
+        )
+        return _GrowState(
+            tree=tree,
+            hist_cache=jnp.zeros((L, F, B, 3), f32).at[0].set(root_hist),
+            cand_gain=neg_inf.at[0].set(root_best.gain),
+            cand_feature=zeros_i.at[0].set(root_best.feature),
+            cand_threshold=zeros_i.at[0].set(root_best.threshold),
+            cand_left_out=zeros_f.at[0].set(root_best.left_output),
+            cand_right_out=zeros_f.at[0].set(root_best.right_output),
+            cand_left_cnt=zeros_i.at[0].set(root_best.left_count),
+            cand_right_cnt=zeros_i.at[0].set(root_best.right_count),
+            cand_left_g=zeros_f.at[0].set(root_best.left_sum_grad),
+            cand_left_h=zeros_f.at[0].set(root_best.left_sum_hess),
+            cand_right_g=zeros_f.at[0].set(root_best.right_sum_grad),
+            cand_right_h=zeros_f.at[0].set(root_best.right_sum_hess),
+            leaf_sum_g=zeros_f.at[0].set(root_g),
+            leaf_sum_h=zeros_f.at[0].set(root_h),
+            leaf_cnt=zeros_i.at[0].set(root_c.astype(jnp.int32)),
+            leaf_depth=zeros_i.at[0].set(1),
+            done=jnp.asarray(False),
+        )
+
+    state = init_state if init_state is not None else _root_state()
 
     def body(_, state: _GrowState) -> _GrowState:
         # pick the best leaf to split (FindBestSplitsForLeaves argmax,
@@ -332,5 +343,60 @@ def grow_tree_impl(bins: jax.Array, grad: jax.Array, hess: jax.Array,
 
         return jax.lax.cond(should_split, do_split, no_split, state)
 
-    state = jax.lax.fori_loop(0, L - 1, body, state)
+    count = L - 1 if loop_count is None else loop_count
+    state = jax.lax.fori_loop(0, count, body, state)
+    return state if return_state else state.tree
+
+
+_GROW_STATICS = ("num_leaves", "num_bins_max", "min_data_in_leaf",
+                 "min_sum_hessian_in_leaf", "max_depth", "hist_backend",
+                 "hist_chunk", "compute_dtype")
+
+
+@functools.partial(jax.jit, static_argnames=_GROW_STATICS)
+def _grow_init(bins, grad, hess, row_mask, feature_mask, num_bins,
+               **kwargs) -> _GrowState:
+    return grow_tree_impl(bins, grad, hess, row_mask, feature_mask,
+                          num_bins, loop_count=0, return_state=True,
+                          **kwargs)
+
+
+# donate the carried state: without aliasing, input and output copies of
+# hist_cache [L,F,B,3] + leaf_ids [N] (~120 MB at bench scale) would both
+# be live at every segment boundary
+@functools.partial(jax.jit, static_argnames=_GROW_STATICS + ("loop_count",),
+                   donate_argnums=(6,))
+def _grow_segment(bins, grad, hess, row_mask, feature_mask, num_bins,
+                  state, *, loop_count, **kwargs) -> _GrowState:
+    return grow_tree_impl(bins, grad, hess, row_mask, feature_mask,
+                          num_bins, init_state=state,
+                          loop_count=loop_count, return_state=True,
+                          **kwargs)
+
+
+def grow_tree_segmented(bins, grad, hess, row_mask, feature_mask, num_bins,
+                        *, segments: int, **kwargs) -> TreeArrays:
+    """grow_tree split across ``segments`` device dispatches.
+
+    A 255-leaf leaf-wise tree is 254 sequential full-data histogram passes
+    in ONE XLA dispatch; at tens of millions of rows that single dispatch
+    can run minutes (and trips this environment's ~60 s per-dispatch
+    execution watchdog, BASELINE.md).  The split loop's body never reads
+    the loop index, so running fori_loop(0, L-1) as ceil((L-1)/segments)-
+    sized pieces with the _GrowState carried device-resident between
+    dispatches is program-identical — same trees, bit for bit.  Equal-size
+    segments share one compiled program (the count, not the start, is the
+    static).
+    """
+    L = kwargs["num_leaves"]
+    total = max(L - 1, 1)
+    per = -(-total // max(segments, 1))
+    state = _grow_init(bins, grad, hess, row_mask, feature_mask, num_bins,
+                       **kwargs)
+    done = 0
+    while done < total:
+        n = min(per, total - done)
+        state = _grow_segment(bins, grad, hess, row_mask, feature_mask,
+                              num_bins, state, loop_count=n, **kwargs)
+        done += n
     return state.tree

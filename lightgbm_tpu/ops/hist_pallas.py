@@ -55,12 +55,15 @@ def _hist_kernel(bins_ref, packed_ref, out_ref, *, F, B, chunk, lanes,
     k0 = (k_j == 0).astype(wide)
     k1 = (k_j == 1).astype(wide)
     k2 = (k_j == 2).astype(wide)
-    packed = packed_ref[...].astype(jnp.int32)              # [4, chunk]
-    v0 = jnp.broadcast_to(packed[0:1, :], (lanes, chunk)).astype(wide)
-    v1 = jnp.broadcast_to(packed[1:2, :], (lanes, chunk)).astype(wide)
-    v2 = jnp.broadcast_to(packed[2:3, :], (lanes, chunk)).astype(wide)
-    cidb = jnp.broadcast_to(packed[3:4, :], (lanes, chunk))  # i32
-    lmask = (cidb == leaf_j).astype(wide)
+    # packed may be int8 (quantized levels) or bf16 (float values); both
+    # convert exactly to ``wide`` (int levels <= 127, cid <= 191 — small
+    # integers are exact in f32, so the cid equality compare is safe)
+    packed = packed_ref[...].astype(wide)                   # [4, chunk]
+    v0 = jnp.broadcast_to(packed[0:1, :], (lanes, chunk))
+    v1 = jnp.broadcast_to(packed[1:2, :], (lanes, chunk))
+    v2 = jnp.broadcast_to(packed[2:3, :], (lanes, chunk))
+    cidb = jnp.broadcast_to(packed[3:4, :], (lanes, chunk))
+    lmask = (cidb == leaf_j.astype(wide)).astype(wide)
     vLt = ((k0 * v0 + k1 * v1 + k2 * v2) * lmask
            ).astype(compute_dtype)                          # [lanes, chunk]
 
@@ -83,17 +86,26 @@ def hist_pallas_raw(bins, packed, *, B: int, chunk: int = 2048,
     """[F, B, lanes] accumulator from [F, N] bins and [4, N] packed values.
 
     Rows must be pre-padded to a multiple of ``chunk`` (pad cid with -1).
-    packed int8 rows: (grad_q, hess_q, ok, cid) — for the bf16 variant the
-    same int8 levels ride bf16 operands (integers <= 127 are bf16-exact),
-    so both dtypes produce bit-identical histograms.  ``bins`` may carry
-    uint8 bit-patterns (the kernel masks the sign-extension back off).
-    ``lanes`` widens the value operand past one MXU tile (192 fits 64 leaf
-    columns in 1.5 tiles instead of two full 128-lane passes).
+    packed rows: (grad, hess, ok, cid).  Three dtype modes:
+      "int8"  — packed int8 quantized levels, int8xint8->int32 MXU;
+      "bf16"  — the SAME int8 levels riding bf16 operands (integers <= 127
+                are bf16-exact), bit-identical histograms to "int8";
+      "bf16v" — packed is [4, N] BFLOAT16 carrying FLOAT grad/hess values
+                (not quantized levels), f32 MXU accumulation.  This is the
+                float-gradient variant: per-value bf16 precision instead of
+                a shared int8 scale, and — being hand-scheduled — immune to
+                XLA einsum-lowering regressions (BASELINE.md round 3).
+    ``bins`` may carry uint8 bit-patterns (the kernel masks the
+    sign-extension back off).  ``lanes`` widens the value operand past one
+    MXU tile (192 fits 64 leaf columns in 1.5 tiles instead of two full
+    128-lane passes).
     """
     F, N = bins.shape
     assert N % chunk == 0 and packed.shape == (4, N)
     compute_dtype = jnp.int8 if dtype == "int8" else jnp.bfloat16
     acc_dtype = jnp.int32 if dtype == "int8" else jnp.float32
+    if dtype == "bf16v":
+        assert packed.dtype == jnp.bfloat16, packed.dtype
     kernel = functools.partial(
         _hist_kernel, F=F, B=B, chunk=chunk, lanes=lanes,
         compute_dtype=compute_dtype, acc_dtype=acc_dtype)
@@ -110,8 +122,8 @@ def hist_pallas_raw(bins, packed, *, B: int, chunk: int = 2048,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",)),
     )(bins, packed)
-    if dtype == "int8":
-        return out
+    if dtype in ("int8", "bf16v"):
+        return out                       # int32 / f32 accumulator as-is
     return out.astype(jnp.int32)
 
 
@@ -197,12 +209,16 @@ def quantize_values(grad, hess, col_ok, rng_bits=None, axis_name=None,
     return vals, jnp.stack([gs, hs, jnp.float32(1.0)])
 
 
-def _grouped(fn, bins, grad, hess, col_id, col_ok, num_cols, B, **kw):
-    """Split wider-than-42 levels into single-MXU-tile groups (the same
-    rule as ops/histogram.histogram_leafbatch)."""
-    if num_cols <= 42:
+def _grouped(fn, bins, grad, hess, col_id, col_ok, num_cols, B, *,
+             group_width=42, **kw):
+    """Split levels wider than ``group_width`` columns into balanced
+    groups (the same rule as ops/histogram.histogram_leafbatch: ceil-split
+    so the last group is never a nearly-empty full pass).  42 = one
+    128-lane MXU tile (XLA paths); the Pallas kernels take 64 (a 192-lane
+    operand is cheaper than two passes)."""
+    if num_cols <= group_width:
         return fn(bins, grad, hess, col_id, col_ok, num_cols, B, **kw)
-    n_groups = -(-num_cols // 42)
+    n_groups = -(-num_cols // group_width)
     width = -(-num_cols // n_groups)
     parts = []
     for base in range(0, num_cols, width):
@@ -224,24 +240,11 @@ def hist_pallas_leafbatch(bins, grad, hess, col_id, col_ok, num_cols: int,
     64 columns run as ONE pass (<=42 columns fill one 128-lane MXU tile;
     43-64 use a 192-lane operand = 1.5 tiles, cheaper than two full
     passes over the data); wider levels split into 64-column groups."""
-    if num_cols <= 64:
-        return _hist_pallas_one(bins, grad, hess, col_id, col_ok, num_cols,
-                                num_bins_max, chunk=chunk, dtype=dtype,
-                                rng_bits=rng_bits, axis_name=axis_name,
-                                int_reduce=int_reduce,
-                                stochastic=stochastic, salt=salt)
-    n_groups = -(-num_cols // 64)
-    width = -(-num_cols // n_groups)
-    parts = []
-    for base in range(0, num_cols, width):
-        k = min(width, num_cols - base)
-        ok = col_ok & (col_id >= base) & (col_id < base + k)
-        parts.append(_hist_pallas_one(
-            bins, grad, hess, col_id - base, ok, k, num_bins_max,
-            chunk=chunk, dtype=dtype, rng_bits=rng_bits,
-            axis_name=axis_name, int_reduce=int_reduce,
-            stochastic=stochastic, salt=salt))
-    return jnp.concatenate(parts, axis=0)
+    return _grouped(_hist_pallas_one, bins, grad, hess, col_id, col_ok,
+                    num_cols, num_bins_max, group_width=64, chunk=chunk,
+                    dtype=dtype, rng_bits=rng_bits, axis_name=axis_name,
+                    int_reduce=int_reduce, stochastic=stochastic,
+                    salt=salt)
 
 
 def _hist_pallas_one(bins, grad, hess, col_id, col_ok, num_cols, B, *,
@@ -275,6 +278,71 @@ def _hist_pallas_one(bins, grad, hess, col_id, col_ok, num_cols, B, *,
     hist = acc[:, :, :num_cols * 3].astype(jnp.float32)
     hist = hist.reshape(F, B, num_cols, 3).transpose(2, 0, 1, 3)
     return hist * scale
+
+
+def hist_pallas_float_leafbatch(bins, grad, hess, col_id, col_ok,
+                                num_cols: int, num_bins_max: int, *,
+                                chunk: int = 2048,
+                                precision: str = "bf16"):
+    """Float-gradient Pallas histogram — [C, F, B, 3] f32, same contract as
+    histogram_leafbatch's einsum formulation but hand-scheduled (and so
+    immune to the environment's XLA einsum-lowering regression, BASELINE.md
+    round-3 addendum).
+
+    precision="bf16"  (hist_dtype=bfloat16): grad/hess ride as single bf16
+      operands — per-value exponents, ~8-bit mantissa, f32 accumulation.
+      One pass over the data, the same MXU cost as the int-level kernel's
+      bf16 mode.
+    precision="f32x2" (hist_dtype=float32 on TPU): two-pass hi/lo bf16
+      split, g = bf16(g) + bf16(g - bf16(g)) — recovers ~16 mantissa bits
+      of the f32 operand (vs 24 native; sums accumulate f32 either way,
+      and the reference's doubles, bin.h:15-17, sit above both).  2x the
+      MXU/HBM cost of one pass — still far below the regressed einsum.
+
+    Counts are exact in every mode: ok rides the hi pass as 1.0 (bf16-exact)
+    and the lo pass carries zeros.
+    """
+    return _grouped(_hist_float_one, bins, grad, hess, col_id, col_ok,
+                    num_cols, num_bins_max, group_width=64, chunk=chunk,
+                    precision=precision)
+
+
+def _hist_float_one(bins, grad, hess, col_id, col_ok, num_cols, B, *,
+                    chunk, precision):
+    F, N = bins.shape
+    lanes = LANES if num_cols <= 42 else 192
+    okf = col_ok.astype(jnp.float32)
+    g = grad.astype(jnp.float32) * okf
+    h = hess.astype(jnp.float32) * okf
+    # cid rides the bf16 side-band: small integers (<= 64 after grouping)
+    # are bf16-exact, and -1 never matches a lane's leaf id
+    cidb = jnp.where(col_ok, col_id, -1).astype(jnp.bfloat16)
+    bins8 = bins.astype(jnp.int8)
+    pad = (-N) % chunk
+    if pad:
+        bins8 = jnp.pad(bins8, ((0, 0), (0, pad)))
+
+    def run(g_, h_, ok_):
+        packed = jnp.stack([g_.astype(jnp.bfloat16),
+                            h_.astype(jnp.bfloat16),
+                            ok_.astype(jnp.bfloat16), cidb], axis=0)
+        if pad:
+            packed = jnp.pad(packed, ((0, 0), (0, pad)),
+                             constant_values=-1)
+        return hist_pallas_raw(bins8, packed, B=B, chunk=chunk,
+                               dtype="bf16v", lanes=lanes)
+
+    if precision == "bf16":
+        acc = run(g, h, okf)
+    elif precision == "f32x2":
+        g_hi = g.astype(jnp.bfloat16).astype(jnp.float32)
+        h_hi = h.astype(jnp.bfloat16).astype(jnp.float32)
+        acc = (run(g_hi, h_hi, okf)
+               + run(g - g_hi, h - h_hi, jnp.zeros_like(okf)))
+    else:
+        raise ValueError(f"unknown float-hist precision {precision!r}")
+    hist = acc[:, :, :num_cols * 3]
+    return hist.reshape(F, B, num_cols, 3).transpose(2, 0, 1, 3)
 
 
 def hist_quant_xla(bins, grad, hess, col_id, col_ok, num_cols: int,

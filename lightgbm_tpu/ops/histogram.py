@@ -18,6 +18,7 @@ A ``segment_sum`` backend exists for comparison/testing; matmul is default.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -27,6 +28,24 @@ import jax.numpy as jnp
 CHUNK_BYTE_BUDGET = 256 << 20
 # virtual (pre-tiling) one-hot budget for the leaf-batched kernel
 LEAFBATCH_VIRTUAL_BUDGET = 8 << 30
+
+
+def _pallas_hist_ok(num_features: int, num_cols: int,
+                    num_bins_max: int) -> bool:
+    """THE Pallas-histogram eligibility rule, shared by the int8 and float
+    dispatches: TPU backend; 8-bit bin ids (max_bin > 256 datasets carry
+    int16 bins the kernel cannot ride); the [F, B, lanes] accumulator
+    (int32 and f32 are the same size) fits ~12 MB of v5e VMEM with
+    headroom for the operand blocks — wider datasets route to the XLA
+    formulations instead of failing Mosaic compilation.
+    LGBM_TPU_HIST_EINSUM=1 forces the XLA formulation for ALL dtypes
+    (A/B timing escape hatch)."""
+    if os.environ.get("LGBM_TPU_HIST_EINSUM", "") == "1":
+        return False
+    if jax.default_backend() != "tpu" or num_bins_max > 256:
+        return False
+    lanes = 128 if num_cols <= 42 else 192
+    return num_features * num_bins_max * lanes * 4 <= 12 * (1 << 20)
 
 
 def histogram_matmul(bins: jax.Array, grad: jax.Array, hess: jax.Array,
@@ -136,17 +155,8 @@ def histogram_leafbatch(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         # XLA int formulation instead.  "int8_sr" = unbiased stochastic
         # rounding (value-keyed deterministic bits).
         stochastic = compute_dtype == "int8_sr"
-        import jax as _jax
         from .hist_pallas import hist_pallas_leafbatch, hist_quant_xla
-        # the Pallas kernel pins the whole [F, B, lanes] int32 accumulator
-        # in VMEM across its row grid; past ~12 MB (v5e VMEM is ~16 MB and
-        # the bins/packed operand blocks need headroom) Mosaic compilation
-        # fails, so wide datasets route to the bit-identical XLA int
-        # formulation instead of crashing
-        lanes = 128 if num_cols <= 42 else 192
-        acc_bytes = bins.shape[0] * num_bins_max * lanes * 4
-        if (_jax.default_backend() == "tpu" and num_bins_max <= 256
-                and acc_bytes <= 12 * (1 << 20)):
+        if _pallas_hist_ok(bins.shape[0], num_cols, num_bins_max):
             return hist_pallas_leafbatch(bins, grad, hess, col_id, col_ok,
                                          num_cols, num_bins_max,
                                          axis_name=axis_name,
@@ -156,6 +166,21 @@ def histogram_leafbatch(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                               num_bins_max, chunk=chunk,
                               axis_name=axis_name, int_reduce=int_reduce,
                               stochastic=stochastic, salt=salt)
+    # float dtypes on TPU: hand-scheduled Pallas kernel with bf16 operands
+    # (f32 splits into two bf16 passes).  This routes AROUND the XLA
+    # one-hot-einsum lowering, whose fast path regressed ~27x in this
+    # environment (BASELINE.md round-3 addendum) — and is the faster
+    # schedule even on a healthy runtime.  Same VMEM guard as the int8
+    # kernel (f32 accumulator == int32 accumulator size); max_bin > 256
+    # datasets carry int16 bins and stay on the einsum.  axis_name is
+    # deliberately NOT handled here: float reductions ride the caller's
+    # hist_reduce hook, exactly like the einsum branch below.
+    if _pallas_hist_ok(bins.shape[0], num_cols, num_bins_max):
+        from .hist_pallas import hist_pallas_float_leafbatch
+        precision = ("bf16" if compute_dtype == jnp.bfloat16 else "f32x2")
+        return hist_pallas_float_leafbatch(bins, grad, hess, col_id,
+                                           col_ok, num_cols, num_bins_max,
+                                           precision=precision)
     F, N = bins.shape
     B = num_bins_max
     # cap the pass at ONE 128-lane tile of the value operand (42 histogram
@@ -301,6 +326,16 @@ def build_histogram(bins, grad, hess, mask, num_bins_max, *,
                                   axis_name=axis_name, salt=salt)
         return out[0]
     if backend == "matmul":
+        if _pallas_hist_ok(bins.shape[0], 1, num_bins_max):
+            # single-leaf float pass on TPU: one-column leafbatch hits the
+            # Pallas kernel (the leaf-wise f32 path rides the same einsum
+            # the regression broke; MXU cost is identical either way — the
+            # value tile is 128 lanes minimum)
+            cid = jnp.zeros((bins.shape[1],), jnp.int32)
+            out = histogram_leafbatch(bins, grad, hess, cid, mask, 1,
+                                      num_bins_max, chunk=chunk,
+                                      compute_dtype=compute_dtype)
+            return out[0]
         return histogram_matmul(bins, grad, hess, mask, num_bins_max,
                                 chunk=chunk, compute_dtype=compute_dtype)
     if backend == "segsum":

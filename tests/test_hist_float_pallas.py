@@ -1,0 +1,127 @@
+"""Float-gradient Pallas histogram path (ops/hist_pallas.py bf16v):
+bf16 single-pass and f32x2 hi/lo variants vs the exact scatter oracle.
+
+This is the round-3 mitigation for the environment's XLA einsum-lowering
+regression (BASELINE.md): the hist_dtype=float32/bfloat16 paths route to a
+hand-scheduled Pallas kernel on TPU.  These tests pin the kernel's math in
+interpret mode; the dispatch itself is TPU-gated (histogram._pallas_hist_ok)
+so the CPU einsum oracle below stays the reference.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from lightgbm_tpu.ops.histogram import (histogram_leafbatch,
+                                        histogram_leafbatch_segsum)
+from lightgbm_tpu.ops.hist_pallas import hist_pallas_float_leafbatch
+
+
+@pytest.fixture(scope="module")
+def hist_inputs():
+    rng = np.random.RandomState(7)
+    F, N, B, C = 5, 4000, 32, 7
+    bins = jnp.asarray(rng.randint(0, B, (F, N)).astype(np.int8))
+    grad = jnp.asarray((rng.randn(N) * 0.4).astype(np.float32))
+    hess = jnp.asarray((rng.rand(N) * 0.25).astype(np.float32))
+    cid = jnp.asarray(rng.randint(0, C, N).astype(np.int32))
+    ok = jnp.asarray(rng.rand(N) < 0.85)
+    return bins, grad, hess, cid, ok, F, N, B, C
+
+
+def test_bf16_variant_matches_rounded_oracle(hist_inputs):
+    """Single-pass bf16: equal to the exact oracle fed bf16-rounded
+    grad/hess (to f32 accumulation-order noise), counts exact."""
+    from jax.experimental.pallas import tpu as pltpu
+    bins, grad, hess, cid, ok, F, N, B, C = hist_inputs
+    g16 = grad.astype(jnp.bfloat16).astype(jnp.float32)
+    h16 = hess.astype(jnp.bfloat16).astype(jnp.float32)
+    want = histogram_leafbatch_segsum(bins, g16, h16, cid, ok, C, B)
+    with pltpu.force_tpu_interpret_mode():
+        got = hist_pallas_float_leafbatch(bins, grad, hess, cid, ok, C, B,
+                                          chunk=1024, precision="bf16")
+    np.testing.assert_array_equal(np.asarray(want[..., 2]),
+                                  np.asarray(got[..., 2]))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-4)
+
+
+def test_f32x2_variant_near_exact(hist_inputs):
+    """Two-pass hi/lo split recovers ~16 operand mantissa bits: per-cell
+    error must sit far below the single-pass bf16 rounding floor."""
+    from jax.experimental.pallas import tpu as pltpu
+    bins, grad, hess, cid, ok, F, N, B, C = hist_inputs
+    want = histogram_leafbatch_segsum(bins, grad, hess, cid, ok, C, B)
+    with pltpu.force_tpu_interpret_mode():
+        got = hist_pallas_float_leafbatch(bins, grad, hess, cid, ok, C, B,
+                                          chunk=1024, precision="f32x2")
+        got_bf = hist_pallas_float_leafbatch(bins, grad, hess, cid, ok, C,
+                                             B, chunk=1024,
+                                             precision="bf16")
+    np.testing.assert_array_equal(np.asarray(want[..., 2]),
+                                  np.asarray(got[..., 2]))
+    w = np.asarray(want)
+    err_x2 = np.abs(np.asarray(got) - w)[..., :2]
+    err_bf = np.abs(np.asarray(got_bf) - w)[..., :2]
+    # bound the hi/lo error by the operand split: |eps| <= 2^-16 per value,
+    # so a cell of n rows with max |v| drifts <= n * maxv * 2^-16 (+ f32
+    # accumulation noise)
+    counts = w[..., 2:3][..., 0][..., None]
+    maxv = max(float(jnp.max(jnp.abs(grad))), float(jnp.max(jnp.abs(hess))))
+    bound = counts * maxv * 2.0**-15 + 1e-5
+    assert (err_x2 <= bound).all()
+    assert err_x2.sum() < 0.05 * err_bf.sum() + 1e-6
+
+
+def test_wide_level_grouping(hist_inputs):
+    """>64 columns split into groups; results must tile back exactly."""
+    from jax.experimental.pallas import tpu as pltpu
+    rng = np.random.RandomState(11)
+    F, N, B, C = 3, 2000, 16, 100
+    bins = jnp.asarray(rng.randint(0, B, (F, N)).astype(np.int8))
+    grad = jnp.asarray(rng.randn(N).astype(np.float32))
+    hess = jnp.asarray(rng.rand(N).astype(np.float32))
+    cid = jnp.asarray(rng.randint(0, C, N).astype(np.int32))
+    ok = jnp.asarray(rng.rand(N) < 0.9)
+    g16 = grad.astype(jnp.bfloat16).astype(jnp.float32)
+    h16 = hess.astype(jnp.bfloat16).astype(jnp.float32)
+    want = histogram_leafbatch_segsum(bins, g16, h16, cid, ok, C, B)
+    with pltpu.force_tpu_interpret_mode():
+        got = hist_pallas_float_leafbatch(bins, grad, hess, cid, ok, C, B,
+                                          chunk=512, precision="bf16")
+    assert got.shape == (C, F, B, 3)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-4)
+
+
+def test_uint8_bins_above_127_not_dropped():
+    """max_bin=255 bins ride as uint8 bit-patterns; the kernel must mask
+    the int8 sign-extension back off (same guarantee as the int8 path)."""
+    from jax.experimental.pallas import tpu as pltpu
+    rng = np.random.RandomState(13)
+    F, N, B, C = 4, 3000, 255, 5
+    bins = jnp.asarray(rng.randint(0, B, (F, N)).astype(np.uint8))
+    grad = jnp.asarray(rng.randn(N).astype(np.float32))
+    hess = jnp.asarray(rng.rand(N).astype(np.float32))
+    cid = jnp.asarray(rng.randint(0, C, N).astype(np.int32))
+    ok = jnp.ones(N, bool)
+    want = histogram_leafbatch_segsum(bins, grad, hess, cid, ok, C, B)
+    with pltpu.force_tpu_interpret_mode():
+        got = hist_pallas_float_leafbatch(bins, grad, hess, cid, ok, C, B,
+                                          chunk=1024, precision="f32x2")
+    np.testing.assert_array_equal(np.asarray(want[..., 2]),
+                                  np.asarray(got[..., 2]))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-3)
+
+
+def test_einsum_dispatch_unaffected_off_tpu(hist_inputs):
+    """On the CPU backend _pallas_hist_ok is False, so the einsum branch
+    still serves float dtypes (the differential-test oracle path)."""
+    bins, grad, hess, cid, ok, F, N, B, C = hist_inputs
+    assert jax.default_backend() != "tpu"
+    a = histogram_leafbatch(bins, grad, hess, cid, ok, C, B,
+                            compute_dtype=jnp.float32)
+    b = histogram_leafbatch_segsum(bins, grad, hess, cid, ok, C, B)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=1e-5, atol=1e-3)
