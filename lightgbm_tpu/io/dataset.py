@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import os
 import pickle
+from struct import error as struct_error
 from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -102,22 +103,41 @@ class Dataset:
                           "lightgbm_tpu cache — delete it to regenerate"
                           % bin_path)
             # a reference-LightGBM cache (dataset.cpp:653-898 layout, no
-            # magic) sitting next to the data file: re-bin from the text
-            # file instead of hard-stopping the run, and never clobber the
-            # user's still-valid reference cache
+            # magic) sitting next to the data file: load it natively —
+            # same bins, mappers and metadata the reference would see —
+            # and never clobber the user's still-valid reference cache
             foreign_bin = True
-            if not os.path.exists(io_config.data_filename):
-                log.fatal("Binary file %s is a reference-LightGBM cache "
-                          "(not loadable by lightgbm_tpu) and the text "
-                          "data file %s does not exist"
-                          % (bin_path, io_config.data_filename))
-            log.warning("Binary file %s is a reference-LightGBM cache; "
-                        "lightgbm_tpu caches use their own format — "
-                        "re-binning from the text file (the reference "
-                        "cache is left untouched)" % bin_path)
+            try:
+                log.info("Loading data set from reference-format binary "
+                         "file")
+                self._load_reference_binary(bin_path, rank, num_machines,
+                                            io_config.is_pre_partition,
+                                            io_config.data_random_seed)
+            except (ValueError, struct_error) as e:
+                self.__dict__.update(cls().__dict__)
+                self.data_filename = io_config.data_filename
+                self.max_bin = io_config.max_bin
+                if not os.path.exists(io_config.data_filename):
+                    log.fatal("Binary file %s is neither a lightgbm_tpu "
+                              "cache nor a readable reference-LightGBM "
+                              "cache (%s), and the text data file %s does "
+                              "not exist"
+                              % (bin_path, e, io_config.data_filename))
+                log.warning("Binary file %s could not be parsed as a "
+                            "reference-LightGBM cache (%s) — re-binning "
+                            "from the text file (the file is left "
+                            "untouched)" % (bin_path, e))
+            else:
+                # the reference cache stores label DATA, not the label
+                # column index — recover a configured label_column (the
+                # name: form needs the text header, when still present)
+                self.label_idx = _label_idx_without_text_load(io_config)
+                self._attach_init_score(io_config.input_init_score,
+                                        predict_fun)
+                return self
             if io_config.is_save_binary_file:
                 log.warning("is_save_binary_file requested but %s is a "
-                            "reference cache — NOT overwriting it; delete "
+                            "foreign file — NOT overwriting it; delete "
                             "or move it to let lightgbm_tpu write its own"
                             % bin_path)
 
@@ -625,24 +645,218 @@ class Dataset:
         self.metadata.set_label(header["label"])
         self.metadata.weights = header["weights"]
         self.metadata.query_boundaries = header["query_boundaries"]
-        if num_machines > 1 and not is_pre_partition:
-            # re-shard cached data (dataset.cpp:840-872); query-atomic when
-            # query boundaries exist, same seed as the fresh-load path so
-            # cached and fresh runs shard identically
-            rng = np.random.RandomState(data_random_seed)
-            qb = self.metadata.query_boundaries
-            if qb is not None:
-                q_owner = rng.randint(0, num_machines, size=qb.size - 1)
-                row_query = np.searchsorted(qb, np.arange(self.num_data),
-                                            side="right") - 1
-                mask = q_owner[row_query] == rank
-            else:
-                mask = rng.randint(0, num_machines, size=self.num_data) == rank
-            idx = np.nonzero(mask)[0]
-            self.bins = np.ascontiguousarray(self.bins[:, idx])
-            self.metadata.partition(idx, self.num_data)
-            self.num_data = idx.size
+        if (self.metadata.weights is not None
+                and self.metadata.query_boundaries is not None):
+            # same recompute as the reference-cache loader: finalize()
+            # only derives query weights on the queries-column path
+            self.metadata._load_query_weights()
+        self._reshard_rows(rank, num_machines, is_pre_partition,
+                           data_random_seed)
         self.metadata.finalize(self.num_data)
+
+    def _reshard_rows(self, rank: int, num_machines: int,
+                      is_pre_partition: bool, data_random_seed: int) -> None:
+        """Re-shard cached rows for distributed training
+        (dataset.cpp:840-872); query-atomic when query boundaries exist,
+        same seed as the fresh-load path so cached and fresh runs shard
+        identically."""
+        if num_machines <= 1 or is_pre_partition:
+            return
+        rng = np.random.RandomState(data_random_seed)
+        qb = self.metadata.query_boundaries
+        if qb is not None:
+            q_owner = rng.randint(0, num_machines, size=qb.size - 1)
+            row_query = np.searchsorted(qb, np.arange(self.num_data),
+                                        side="right") - 1
+            mask = q_owner[row_query] == rank
+        else:
+            mask = rng.randint(0, num_machines, size=self.num_data) == rank
+        idx = np.nonzero(mask)[0]
+        self.bins = np.ascontiguousarray(self.bins[:, idx])
+        self.metadata.partition(idx, self.num_data)
+        self.num_data = idx.size
+
+    def _load_reference_binary(self, path: str, rank: int,
+                               num_machines: int, is_pre_partition: bool,
+                               data_random_seed: int = 1) -> None:
+        """Load a binary cache WRITTEN BY THE REFERENCE BINARY
+        (Dataset::SaveBinaryFile, dataset.cpp:653-713): little-endian,
+        tightly packed —
+
+          size_t header_size; { size_t global_num_data; bool sparse;
+          int max_bin; int32 num_data; int num_features;
+          int num_total_features; size_t n_map; int map[n_map];
+          (int len, char[len]) x num_total_features names }
+          size_t metadata_size; { int32 num_data, num_weights,
+          num_queries; float label[num_data]; float weights[]?;
+          int32 query_boundaries[num_queries+1]?; float query_weights[]? }
+          per feature: size_t size; { int feature_index; bool is_sparse;
+          BinMapper{int num_bin; bool is_trival; double sparse_rate;
+          double upper[num_bin]} ; bin data }
+
+        Dense bin data is a raw uint8/16/32 row (width by num_bin,
+        bin.cpp:202-210); sparse is the delta stream of
+        sparse_bin.hpp:178-187 (int32 n; uint8 delta[n+1]; VAL_T vals[n])
+        whose positions are the running delta sum and whose absent rows
+        read back as bin 0 (SparseBinIterator::Get) — gap-filler entries
+        carry val 0 and land harmlessly.  NOTE: we parse the layout
+        SaveBinaryToFile actually WRITES; the reference's own
+        Metadata::LoadFromMemory advances by num_weights (not num_data)
+        floats past the label block (metadata.cpp:313), a defect that
+        garbles its own caches when a query file is present without
+        weights.  Raises ValueError on malformed input (the caller falls
+        back to re-binning the text file)."""
+        import struct
+
+        def take(buf, fmt, off):
+            vals = struct.unpack_from("<" + fmt, buf, off)
+            return vals, off + struct.calcsize("<" + fmt)
+
+        with open(path, "rb") as f:
+            def read_block(what):
+                raw = f.read(8)
+                if len(raw) != 8:
+                    raise ValueError("truncated at %s size" % what)
+                n = struct.unpack("<Q", raw)[0]
+                if n > (64 << 30):
+                    raise ValueError("implausible %s size %d" % (what, n))
+                blob = f.read(n)
+                if len(blob) != n:
+                    raise ValueError("truncated %s" % what)
+                return blob
+
+            head = read_block("header")
+            (global_num_data,), off = take(head, "Q", 0)
+            off += 1                                  # is_enable_sparse
+            (max_bin, num_data, num_features,
+             num_total_features), off = take(head, "iiii", off)
+            (n_map,), off = take(head, "Q", off)
+            if not (0 < num_features <= n_map
+                    and num_features <= num_total_features):
+                raise ValueError("inconsistent feature counts")
+            off += 4 * n_map                          # used_feature_map:
+            # rebuilt below from each Feature's own feature_index
+            names = []
+            for _ in range(num_total_features):
+                (ln,), off = take(head, "i", off)
+                if ln < 0 or off + ln > len(head):
+                    raise ValueError("bad feature-name length")
+                names.append(head[off:off + ln].decode("utf-8", "replace"))
+                off += ln
+
+            meta = read_block("metadata")
+            (md_n, md_w, md_q), off = take(meta, "iii", 0)
+            if md_n != num_data:
+                raise ValueError("metadata/header row-count mismatch")
+            label = np.frombuffer(meta, "<f4", md_n, off).copy()
+            off += 4 * md_n
+            weights = qb = None
+            if md_w > 0:
+                weights = np.frombuffer(meta, "<f4", md_w, off).copy()
+                off += 4 * md_w
+            if md_q > 0:
+                qb = np.frombuffer(meta, "<i4", md_q + 1, off).copy()
+                off += 4 * (md_q + 1)
+            # query_weights (if present) are recomputed by finalize()
+
+            mappers: List[BinMapper] = []
+            real_idx: List[int] = []
+            cols: List[np.ndarray] = []
+            for i in range(num_features):
+                blob = read_block("feature %d" % i)
+                (fidx,), off = take(blob, "i", 0)
+                is_sparse = blob[off] != 0
+                off += 1
+                (num_bin,), off = take(blob, "i", off)
+                is_trivial = blob[off] != 0
+                off += 1
+                (sparse_rate,), off = take(blob, "d", off)
+                if not (0 < num_bin <= (1 << 24)):
+                    raise ValueError("bad num_bin %d" % num_bin)
+                upper = np.frombuffer(blob, "<f8", num_bin, off).copy()
+                off += 8 * num_bin
+                vt = ("<u1" if num_bin <= 256
+                      else "<u2" if num_bin <= 65536 else "<u4")
+                if not is_sparse:
+                    # a view into blob is fine: the blob IS the column
+                    # (astype/stack below materialize fresh memory)
+                    col = np.frombuffer(blob, vt, num_data, off)
+                else:
+                    (nv,), off = take(blob, "i", off)
+                    delta = np.frombuffer(blob, "<u1", nv + 1, off)
+                    off += nv + 1
+                    vals = np.frombuffer(blob, vt, nv, off)
+                    pos = np.cumsum(delta[:nv].astype(np.int64))
+                    if nv and pos[-1] >= num_data:
+                        raise ValueError("sparse position out of range")
+                    col = np.zeros(num_data, dtype=vt)
+                    col[pos] = vals
+                mappers.append(BinMapper(num_bin=num_bin,
+                                         is_trivial=bool(is_trivial),
+                                         sparse_rate=float(sparse_rate),
+                                         bin_upper_bound=upper))
+                real_idx.append(fidx)
+                cols.append(col)
+
+        order = np.argsort(np.asarray(real_idx, dtype=np.int64),
+                           kind="stable")
+        self.num_data = num_data
+        self.global_num_data = int(global_num_data) or num_data
+        self.num_total_features = num_total_features
+        self.feature_names = names
+        self.max_bin = max_bin
+        self.bin_mappers = [mappers[j] for j in order]
+        self.used_feature_map = {int(real_idx[j]): k
+                                 for k, j in enumerate(order)}
+        self.real_feature_idx = np.array(sorted(self.used_feature_map),
+                                         dtype=np.int32)
+        self.num_bins = np.array([m.num_bin for m in self.bin_mappers],
+                                 dtype=np.int32)
+        dtype = _bin_dtype(int(self.num_bins.max()))
+        self.bins = np.ascontiguousarray(
+            np.stack([cols[j].astype(dtype, copy=False) for j in order],
+                     axis=0))
+        self.metadata.set_label(label)
+        self.metadata.weights = weights
+        self.metadata.query_boundaries = qb
+        if weights is not None and qb is not None:
+            # finalize() only derives query weights on the queries-column
+            # path; side-file-style weights+queries need the explicit
+            # recompute (metadata.cpp:286-298)
+            self.metadata._load_query_weights()
+        self._reshard_rows(rank, num_machines, is_pre_partition,
+                           data_random_seed)
+        self.metadata.finalize(self.num_data)
+
+
+def _label_idx_without_text_load(io_config) -> int:
+    """Resolve label_column to an index for binary-cache loads, where no
+    text parse happens: numeric directly; ``name:`` via the text header
+    if the file is still on disk (application.cpp resolves names the same
+    way before any data read)."""
+    lc = io_config.label_column
+    if not lc:
+        return 0
+    if not lc.startswith("name:"):
+        try:
+            return int(lc)
+        except ValueError:
+            log.fatal("label_column is not a number, if you want to use "
+                      "column name, please add prefix \"name:\" before "
+                      "column name")
+    name = lc[len("name:"):]
+    if io_config.has_header and os.path.exists(io_config.data_filename):
+        with open(io_config.data_filename, "r") as f:
+            first = f.readline().rstrip("\r\n")
+        delim = "\t" if first.count("\t") > first.count(",") else ","
+        names = first.split(delim)
+        if name in names:
+            return names.index(name)
+        log.fatal("cannot find label column: %s in data file" % name)
+    log.warning("label_column=%s cannot be resolved without the text "
+                "file's header; keeping label_index=0 (only the saved "
+                "model's label_index field is affected)" % lc)
+    return 0
 
 
 def _resolve_columns(io_config) -> Tuple[int, int, int, set, Optional[List[str]]]:
