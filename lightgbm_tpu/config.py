@@ -245,12 +245,16 @@ class TreeConfig:
     grow_policy: str = "leafwise"
     # TPU tuning knobs (no reference equivalent): row-chunk length of the
     # histogram scan (0 = per-policy default) and the one-hot/value operand
-    # dtype of the histogram matmul ("float32" exact, "bfloat16" rounds
-    # grad/hess to 8 mantissa bits before the f32-accumulated matmul,
-    # "int8" = quantized-gradient histograms on the int8 MXU via the Pallas
-    # kernel — ~2x faster passes, grad/hess rounded to 1/127 of their
-    # per-pass max; counts stay exact).  hist_chunk tunes the XLA scan
-    # paths only; the int8 Pallas kernel uses its own fixed VMEM block.
+    # dtype of the histogram matmul.  On TPU all three dtypes run
+    # hand-scheduled Pallas MXU kernels (ops/hist_pallas.py): "float32"
+    # rides a two-pass hi/lo bf16 operand split (~16 operand mantissa
+    # bits, f32 accumulation — the closest-to-reference mode), "bfloat16"
+    # a single pass (grad/hess rounded to 8 mantissa bits; ~2x f32 speed
+    # at a fraction of int8's quantization error), "int8" the
+    # quantized-gradient kernel on the int8 MXU — fastest, grad/hess
+    # rounded to 1/127 of their per-pass max; counts stay exact in every
+    # mode.  hist_chunk tunes the XLA scan paths only; the Pallas kernels
+    # use their own fixed VMEM block.
     # int8 is capped at ~16.9M GLOBAL rows (int32 accumulator: 127 x rows
     # can wrap past 2^31 when rows concentrate in one bin — see
     # models/gbdt.check_int8_row_capacity, which refuses loudly).
