@@ -37,9 +37,12 @@ LANES = 128  # default value-operand width: 42 leaf columns x 3 stats + 2
 
 def _hist_kernel(bins_ref, packed_ref, out_ref, *, F, B, chunk, lanes,
                  compute_dtype, acc_dtype):
-    i = pl.program_id(0)
+    # grid = (feature_blocks, row_chunks), rows minor: each feature
+    # block's accumulator lives in VMEM across its whole row sweep and is
+    # written back to HBM once
+    j = pl.program_id(1)
 
-    @pl.when(i == 0)
+    @pl.when(j == 0)
     def _():
         out_ref[...] = jnp.zeros_like(out_ref)
 
@@ -99,6 +102,11 @@ def hist_pallas_raw(bins, packed, *, B: int, chunk: int = 2048,
     sign-extension back off).  ``lanes`` widens the value operand past one
     MXU tile (192 fits 64 leaf columns in 1.5 tiles instead of two full
     128-lane passes).
+
+    Wide datasets ride a FEATURE-BLOCK grid axis: the [Fb, B, lanes]
+    accumulator of one block fits VMEM (~12 MB) and each block sweeps the
+    rows in turn, so F is unbounded (the row side-band is re-read per
+    block — F/Fb x a few MB of HBM, noise next to the matmuls).
     """
     F, N = bins.shape
     assert N % chunk == 0 and packed.shape == (4, N)
@@ -106,25 +114,53 @@ def hist_pallas_raw(bins, packed, *, B: int, chunk: int = 2048,
     acc_dtype = jnp.int32 if dtype == "int8" else jnp.float32
     if dtype == "bf16v":
         assert packed.dtype == jnp.bfloat16, packed.dtype
+    if F <= feature_block(B, lanes):
+        # single block: the output window is constant across the grid, so
+        # Mosaic keeps ONE VMEM copy — the full ~12 MB budget applies
+        # (the round-2 kernel ran exactly this shape)
+        fb, n_fblocks = F, 1
+    else:
+        # multi-block: the output window rotates with grid axis i, which
+        # Mosaic DOUBLE-BUFFERS — budget half the VMEM per block.  Blocks
+        # are balanced (100 features -> 2 x 56, not 96 + 96-with-92-pad:
+        # padded features cost full matmul passes)
+        fb_max = feature_block(B, lanes, budget=6 << 20)
+        n_fblocks = -(-F // fb_max)
+        fb = -(-F // n_fblocks)
+        fb += (-fb) % 8                       # sublane-tile multiple
+        pad_f = n_fblocks * fb - F
+        if pad_f:
+            bins = jnp.pad(bins, ((0, pad_f), (0, 0)))
     kernel = functools.partial(
-        _hist_kernel, F=F, B=B, chunk=chunk, lanes=lanes,
+        _hist_kernel, F=fb, B=B, chunk=chunk, lanes=lanes,
         compute_dtype=compute_dtype, acc_dtype=acc_dtype)
-    grid = N // chunk
     out = pl.pallas_call(
         kernel,
-        grid=(grid,),
+        grid=(n_fblocks, N // chunk),
         in_specs=[
-            pl.BlockSpec((F, chunk), lambda i: (0, i)),
-            pl.BlockSpec((4, chunk), lambda i: (0, i)),
+            pl.BlockSpec((fb, chunk), lambda i, j: (i, j)),
+            pl.BlockSpec((4, chunk), lambda i, j: (0, j)),
         ],
-        out_specs=pl.BlockSpec((F, B, lanes), lambda i: (0, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((F, B, lanes), acc_dtype),
+        out_specs=pl.BlockSpec((fb, B, lanes), lambda i, j: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((n_fblocks * fb, B, lanes),
+                                       acc_dtype),
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary",)),
+            dimension_semantics=("arbitrary", "arbitrary")),
     )(bins, packed)
+    out = out[:F]
     if dtype in ("int8", "bf16v"):
         return out                       # int32 / f32 accumulator as-is
     return out.astype(jnp.int32)
+
+
+def feature_block(B: int, lanes: int, budget: int = 12 << 20) -> int:
+    """Features per VMEM-resident accumulator block: the largest multiple
+    of 8 (sublane tile) whose [Fb, B, lanes] int32/f32 block fits the
+    given budget (~12 MB of v5e VMEM with operand headroom for the
+    single-buffered case; callers halve it when the block rotates across
+    the grid and Mosaic double-buffers it)."""
+    fb = budget // (B * lanes * 4)
+    return max(8, fb - fb % 8)
 
 
 def _mix32(x):

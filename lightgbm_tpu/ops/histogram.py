@@ -30,22 +30,16 @@ CHUNK_BYTE_BUDGET = 256 << 20
 LEAFBATCH_VIRTUAL_BUDGET = 8 << 30
 
 
-def _pallas_hist_ok(num_features: int, num_cols: int,
-                    num_bins_max: int) -> bool:
+def _pallas_hist_ok(num_bins_max: int) -> bool:
     """THE Pallas-histogram eligibility rule, shared by the int8 and float
-    dispatches: TPU backend; 8-bit bin ids (max_bin > 256 datasets carry
-    int16 bins the kernel cannot ride); the [F, B, lanes] accumulator
-    (int32 and f32 are the same size) fits ~12 MB of v5e VMEM with
-    headroom for the operand blocks — wider datasets route to the XLA
-    formulations instead of failing Mosaic compilation.
-    LGBM_TPU_HIST_EINSUM=1 forces the XLA formulation for ALL dtypes
-    (A/B timing escape hatch)."""
+    dispatches: TPU backend and 8-bit bin ids (max_bin > 256 datasets
+    carry int16 bins the kernel cannot ride).  Dataset WIDTH is unbounded:
+    the kernel grids over VMEM-sized feature blocks
+    (hist_pallas.feature_block).  LGBM_TPU_HIST_EINSUM=1 forces the XLA
+    formulation for ALL dtypes (A/B timing escape hatch)."""
     if os.environ.get("LGBM_TPU_HIST_EINSUM", "") == "1":
         return False
-    if jax.default_backend() != "tpu" or num_bins_max > 256:
-        return False
-    lanes = 128 if num_cols <= 42 else 192
-    return num_features * num_bins_max * lanes * 4 <= 12 * (1 << 20)
+    return jax.default_backend() == "tpu" and num_bins_max <= 256
 
 
 def histogram_matmul(bins: jax.Array, grad: jax.Array, hess: jax.Array,
@@ -156,7 +150,7 @@ def histogram_leafbatch(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         # rounding (value-keyed deterministic bits).
         stochastic = compute_dtype == "int8_sr"
         from .hist_pallas import hist_pallas_leafbatch, hist_quant_xla
-        if _pallas_hist_ok(bins.shape[0], num_cols, num_bins_max):
+        if _pallas_hist_ok(num_bins_max):
             return hist_pallas_leafbatch(bins, grad, hess, col_id, col_ok,
                                          num_cols, num_bins_max,
                                          axis_name=axis_name,
@@ -170,12 +164,12 @@ def histogram_leafbatch(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     # (f32 splits into two bf16 passes).  This routes AROUND the XLA
     # one-hot-einsum lowering, whose fast path regressed ~27x in this
     # environment (BASELINE.md round-3 addendum) — and is the faster
-    # schedule even on a healthy runtime.  Same VMEM guard as the int8
-    # kernel (f32 accumulator == int32 accumulator size); max_bin > 256
-    # datasets carry int16 bins and stay on the einsum.  axis_name is
-    # deliberately NOT handled here: float reductions ride the caller's
-    # hist_reduce hook, exactly like the einsum branch below.
-    if _pallas_hist_ok(bins.shape[0], num_cols, num_bins_max):
+    # schedule even on a healthy runtime.  Width is handled inside the
+    # kernel (VMEM-sized feature-block grid); max_bin > 256 datasets
+    # carry int16 bins and stay on the einsum.  axis_name is deliberately
+    # NOT handled here: float reductions ride the caller's hist_reduce
+    # hook, exactly like the einsum branch below.
+    if _pallas_hist_ok(num_bins_max):
         from .hist_pallas import hist_pallas_float_leafbatch
         precision = ("bf16" if compute_dtype == jnp.bfloat16 else "f32x2")
         return hist_pallas_float_leafbatch(bins, grad, hess, col_id,
@@ -326,7 +320,7 @@ def build_histogram(bins, grad, hess, mask, num_bins_max, *,
                                   axis_name=axis_name, salt=salt)
         return out[0]
     if backend == "matmul":
-        if _pallas_hist_ok(bins.shape[0], 1, num_bins_max):
+        if _pallas_hist_ok(num_bins_max):
             # single-leaf float pass on TPU: one-column leafbatch hits the
             # Pallas kernel (the leaf-wise f32 path rides the same einsum
             # the regression broke; MXU cost is identical either way — the

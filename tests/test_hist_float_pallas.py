@@ -125,3 +125,36 @@ def test_einsum_dispatch_unaffected_off_tpu(hist_inputs):
     b = histogram_leafbatch_segsum(bins, grad, hess, cid, ok, C, B)
     np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                rtol=1e-5, atol=1e-3)
+
+
+def test_wide_dataset_feature_grid():
+    """Datasets wider than one VMEM accumulator block (feature_block() =
+    96 at B=256/lanes=128) ride the kernel's feature-block grid axis —
+    int8 stays bit-identical to the XLA oracle, bf16v matches the rounded
+    oracle; pad features are sliced off."""
+    from jax.experimental.pallas import tpu as pltpu
+    from lightgbm_tpu.ops.hist_pallas import (feature_block,
+                                              hist_pallas_leafbatch,
+                                              hist_quant_xla)
+    rng = np.random.RandomState(17)
+    F, N, B, C = 100, 1024, 256, 5
+    assert F > feature_block(B, 128)
+    bins = jnp.asarray(rng.randint(0, B, (F, N)).astype(np.uint8))
+    grad = jnp.asarray(rng.randn(N).astype(np.float32))
+    hess = jnp.asarray(rng.rand(N).astype(np.float32))
+    cid = jnp.asarray(rng.randint(0, C, N).astype(np.int32))
+    ok = jnp.asarray(rng.rand(N) < 0.9)
+    want_int = hist_quant_xla(bins, grad, hess, cid, ok, C, B)
+    g16 = grad.astype(jnp.bfloat16).astype(jnp.float32)
+    h16 = hess.astype(jnp.bfloat16).astype(jnp.float32)
+    want_f = histogram_leafbatch_segsum(bins, g16, h16, cid, ok, C, B)
+    with pltpu.force_tpu_interpret_mode():
+        got_int = hist_pallas_leafbatch(bins, grad, hess, cid, ok, C, B,
+                                        chunk=512, dtype="int8")
+        got_f = hist_pallas_float_leafbatch(bins, grad, hess, cid, ok, C,
+                                            B, chunk=512,
+                                            precision="bf16")
+    np.testing.assert_array_equal(np.asarray(want_int), np.asarray(got_int))
+    assert got_f.shape == (C, F, B, 3)
+    np.testing.assert_allclose(np.asarray(got_f), np.asarray(want_f),
+                               rtol=1e-5, atol=1e-4)
