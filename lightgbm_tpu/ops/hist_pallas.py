@@ -122,8 +122,9 @@ def hist_pallas_raw(bins, packed, *, B: int, chunk: int = 2048,
     else:
         # multi-block: the output window rotates with grid axis i, which
         # Mosaic DOUBLE-BUFFERS — budget half the VMEM per block.  Blocks
-        # are balanced (100 features -> 2 x 56, not 96 + 96-with-92-pad:
-        # padded features cost full matmul passes)
+        # are balanced: with fb_max=48 (B=256, lanes=128), 100 features
+        # run as 3 x 40 (20 pad) instead of 48+48+48 (44 pad) — padded
+        # features cost full matmul passes
         fb_max = feature_block(B, lanes, budget=6 << 20)
         n_fblocks = -(-F // fb_max)
         fb = -(-F // n_fblocks)

@@ -95,6 +95,23 @@ def _train_ours(parity_data, grow_policy, hist_dtype, monkeypatch):
     if hist_dtype == "int8":
         monkeypatch.setattr(gd_mod, "histogram_leafbatch",
                             hist_mod.hist_quant_segsum)
+    elif hist_dtype == "bfloat16":
+        # model the TPU float-gradient Pallas kernel's operand rounding
+        # (ops/hist_pallas bf16v: grad/hess ride bf16, f32 accumulation;
+        # order differs from the kernel like the f32 oracle does)
+        import jax.numpy as jnp
+
+        def bf16_seg(bins, grad, hess, cid, ok, C, B, **kw):
+            g = grad.astype(jnp.bfloat16).astype(jnp.float32)
+            h = hess.astype(jnp.bfloat16).astype(jnp.float32)
+            return hist_mod.histogram_leafbatch_segsum(bins, g, h, cid,
+                                                       ok, C, B)
+        monkeypatch.setattr(gd_mod, "histogram_leafbatch", bf16_seg)
+        # keep hist_dtype=float32 in the config below: the segsum stub
+        # above carries the bf16 semantics, and the real bfloat16 config
+        # value would re-route to the einsum with bf16 operands (slow on
+        # the CPU mesh)
+        hist_dtype = "float32"
     else:
         monkeypatch.setattr(gd_mod, "histogram_leafbatch",
                             hist_mod.histogram_leafbatch_segsum)
@@ -127,6 +144,7 @@ def _train_ours(parity_data, grow_policy, hist_dtype, monkeypatch):
     ("depthwise", "float32"),
     ("leafwise", "float32"),
     ("depthwise", "int8"),
+    ("depthwise", "bfloat16"),
 ])
 def test_auc_parity_vs_reference(parity_data, reference_auc, grow_policy,
                                  hist_dtype, monkeypatch):
