@@ -161,9 +161,10 @@ def main() -> int:
             # execution watchdog: segment the per-tree split loop so each
             # dispatch stays ~30 s (bit-identical trees,
             # models/grower.grow_tree_segmented).  Coefficients = measured
-            # per-row-per-split pass cost on v5e per kernel (f32x2 is two
-            # bf16 passes, bfloat16 one, int8 one at 2x rate).
-            per_row = {"float32": 2.8e-8, "bfloat16": 1.5e-8,
+            # per-row-per-split pass cost on v5e per kernel (leaf-wise
+            # passes are single-column, so f32's 5-stat single pass costs
+            # ~one bf16 pass; int8 runs at 2x the bf16 rate).
+            per_row = {"float32": 1.6e-8, "bfloat16": 1.5e-8,
                        "int8": 9e-9}[hist_dtype]
             split_s = args.rows * per_row
             segs = max(1, math.ceil((args.leaves - 1) * split_s / 30.0))

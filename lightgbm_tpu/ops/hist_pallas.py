@@ -36,7 +36,7 @@ LANES = 128  # default value-operand width: 42 leaf columns x 3 stats + 2
 
 
 def _hist_kernel(bins_ref, packed_ref, out_ref, *, F, B, chunk, lanes,
-                 compute_dtype, acc_dtype):
+                 compute_dtype, acc_dtype, stats=3):
     # grid = (feature_blocks, row_chunks), rows minor: each feature
     # block's accumulator lives in VMEM across its whole row sweep and is
     # written back to HBM once
@@ -51,24 +51,24 @@ def _hist_kernel(bins_ref, packed_ref, out_ref, *, F, B, chunk, lanes,
     # unsupported) and casts to compute_dtype only for the MXU operands.
     # Everything is LANE-major ([*, chunk]); the value block vL is built
     # TRANSPOSED [lanes, chunk] so the contraction is an NT-form matmul.
+    # ``stats`` values interleave per leaf column (3 = grad/hess/count;
+    # 5 = the f32 single-pass hi/lo packing g_hi,g_lo,h_hi,h_lo,count).
     wide = jnp.int32 if compute_dtype == jnp.int8 else jnp.float32
     jrow = jax.lax.broadcasted_iota(jnp.int32, (lanes, chunk), 0)
-    leaf_j = jrow // 3
-    k_j = jrow - 3 * leaf_j
-    k0 = (k_j == 0).astype(wide)
-    k1 = (k_j == 1).astype(wide)
-    k2 = (k_j == 2).astype(wide)
+    leaf_j = jrow // stats
+    k_j = jrow - stats * leaf_j
     # packed may be int8 (quantized levels) or bf16 (float values); both
     # convert exactly to ``wide`` (int levels <= 127, cid <= 191 — small
     # integers are exact in f32, so the cid equality compare is safe)
-    packed = packed_ref[...].astype(wide)                   # [4, chunk]
-    v0 = jnp.broadcast_to(packed[0:1, :], (lanes, chunk))
-    v1 = jnp.broadcast_to(packed[1:2, :], (lanes, chunk))
-    v2 = jnp.broadcast_to(packed[2:3, :], (lanes, chunk))
-    cidb = jnp.broadcast_to(packed[3:4, :], (lanes, chunk))
+    packed = packed_ref[...].astype(wide)           # [stats + 1, chunk]
+    terms = None
+    for k in range(stats):
+        vk = ((k_j == k).astype(wide)
+              * jnp.broadcast_to(packed[k:k + 1, :], (lanes, chunk)))
+        terms = vk if terms is None else terms + vk
+    cidb = jnp.broadcast_to(packed[stats:stats + 1, :], (lanes, chunk))
     lmask = (cidb == leaf_j.astype(wide)).astype(wide)
-    vLt = ((k0 * v0 + k1 * v1 + k2 * v2) * lmask
-           ).astype(compute_dtype)                          # [lanes, chunk]
+    vLt = (terms * lmask).astype(compute_dtype)     # [lanes, chunk]
 
     iota_b = jax.lax.broadcasted_iota(jnp.int32, (B, chunk), 0)
     dn = (((1,), (1,)), ((), ()))                           # contract chunk
@@ -83,17 +83,21 @@ def _hist_kernel(bins_ref, packed_ref, out_ref, *, F, B, chunk, lanes,
             preferred_element_type=acc_dtype)               # [B, LANES]
 
 
-@functools.partial(jax.jit, static_argnames=("B", "chunk", "dtype", "lanes"))
+@functools.partial(jax.jit, static_argnames=("B", "chunk", "dtype", "lanes",
+                                             "stats"))
 def hist_pallas_raw(bins, packed, *, B: int, chunk: int = 2048,
-                    dtype: str = "int8", lanes: int = LANES):
-    """[F, B, lanes] accumulator from [F, N] bins and [4, N] packed values.
+                    dtype: str = "int8", lanes: int = LANES,
+                    stats: int = 3):
+    """[F, B, lanes] accumulator from [F, N] bins and packed values.
 
     Rows must be pre-padded to a multiple of ``chunk`` (pad cid with -1).
-    packed rows: (grad, hess, ok, cid).  Three dtype modes:
+    packed is [stats + 1, N]: ``stats`` values per leaf column followed by
+    the cid row (stats=3: grad, hess, ok; stats=5: the f32 hi/lo packing
+    g_hi, g_lo, h_hi, h_lo, ok).  Three dtype modes:
       "int8"  — packed int8 quantized levels, int8xint8->int32 MXU;
       "bf16"  — the SAME int8 levels riding bf16 operands (integers <= 127
                 are bf16-exact), bit-identical histograms to "int8";
-      "bf16v" — packed is [4, N] BFLOAT16 carrying FLOAT grad/hess values
+      "bf16v" — packed is BFLOAT16 carrying FLOAT grad/hess values
                 (not quantized levels), f32 MXU accumulation.  This is the
                 float-gradient variant: per-value bf16 precision instead of
                 a shared int8 scale, and — being hand-scheduled — immune to
@@ -109,7 +113,7 @@ def hist_pallas_raw(bins, packed, *, B: int, chunk: int = 2048,
     block — F/Fb x a few MB of HBM, noise next to the matmuls).
     """
     F, N = bins.shape
-    assert N % chunk == 0 and packed.shape == (4, N)
+    assert N % chunk == 0 and packed.shape == (stats + 1, N)
     compute_dtype = jnp.int8 if dtype == "int8" else jnp.bfloat16
     acc_dtype = jnp.int32 if dtype == "int8" else jnp.float32
     if dtype == "bf16v":
@@ -134,13 +138,13 @@ def hist_pallas_raw(bins, packed, *, B: int, chunk: int = 2048,
             bins = jnp.pad(bins, ((0, pad_f), (0, 0)))
     kernel = functools.partial(
         _hist_kernel, F=fb, B=B, chunk=chunk, lanes=lanes,
-        compute_dtype=compute_dtype, acc_dtype=acc_dtype)
+        compute_dtype=compute_dtype, acc_dtype=acc_dtype, stats=stats)
     out = pl.pallas_call(
         kernel,
         grid=(n_fblocks, N // chunk),
         in_specs=[
             pl.BlockSpec((fb, chunk), lambda i, j: (i, j)),
-            pl.BlockSpec((4, chunk), lambda i, j: (0, j)),
+            pl.BlockSpec((stats + 1, chunk), lambda i, j: (0, j)),
         ],
         out_specs=pl.BlockSpec((fb, B, lanes), lambda i, j: (i, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((n_fblocks * fb, B, lanes),
@@ -330,15 +334,28 @@ def hist_pallas_float_leafbatch(bins, grad, hess, col_id, col_ok,
       operands — per-value exponents, ~8-bit mantissa, f32 accumulation.
       One pass over the data, the same MXU cost as the int-level kernel's
       bf16 mode.
-    precision="f32x2" (hist_dtype=float32 on TPU): two-pass hi/lo bf16
-      split, g = bf16(g) + bf16(g - bf16(g)) — recovers ~16 mantissa bits
-      of the f32 operand (vs 24 native; sums accumulate f32 either way,
-      and the reference's doubles, bin.h:15-17, sit above both).  2x the
-      MXU/HBM cost of one pass — still far below the regressed einsum.
+    precision="f32" (hist_dtype=float32 on TPU): hi/lo bf16 split,
+      g = bf16(g) + bf16(g - bf16(g)) — recovers ~16 mantissa bits of the
+      f32 operand (vs 24 native; sums accumulate f32 either way, and the
+      reference's doubles, bin.h:15-17, sit above both).  Levels up to 38
+      columns run as ONE pass with FIVE stats per column (g_hi, g_lo,
+      h_hi, h_lo, count — "f32x1"; 25 columns fill a 128-lane tile, 38
+      fill 192): measured 2x faster than two 3-stat passes at 8 columns,
+      1.4x at 25.  Wider levels run the SAME hi/lo split as TWO 3-stat
+      passes over 64-column groups ("f32x2" — equal MXU units there, and
+      fewer per-pass overheads than grouped 5-stat).  Both orderings
+      accumulate identical per-lane f32 partial sums, so the choice is
+      bit-invisible; "f32x1"/"f32x2" force one variant (A/B tests).
 
-    Counts are exact in every mode: ok rides the hi pass as 1.0 (bf16-exact)
-    and the lo pass carries zeros.
+    Counts are exact in every mode: ok rides as 1.0 (bf16-exact) and the
+    lo lanes carry zeros.
     """
+    if precision == "f32":
+        precision = "f32x1" if num_cols <= 38 else "f32x2"
+    if precision == "f32x1":
+        return _grouped(_hist_float_one, bins, grad, hess, col_id,
+                        col_ok, num_cols, num_bins_max, group_width=38,
+                        chunk=chunk, precision=precision)
     return _grouped(_hist_float_one, bins, grad, hess, col_id, col_ok,
                     num_cols, num_bins_max, group_width=64, chunk=chunk,
                     precision=precision)
@@ -347,7 +364,6 @@ def hist_pallas_float_leafbatch(bins, grad, hess, col_id, col_ok,
 def _hist_float_one(bins, grad, hess, col_id, col_ok, num_cols, B, *,
                     chunk, precision):
     F, N = bins.shape
-    lanes = LANES if num_cols <= 42 else 192
     okf = col_ok.astype(jnp.float32)
     g = grad.astype(jnp.float32) * okf
     h = hess.astype(jnp.float32) * okf
@@ -359,23 +375,33 @@ def _hist_float_one(bins, grad, hess, col_id, col_ok, num_cols, B, *,
     if pad:
         bins8 = jnp.pad(bins8, ((0, 0), (0, pad)))
 
-    def run(g_, h_, ok_):
-        packed = jnp.stack([g_.astype(jnp.bfloat16),
-                            h_.astype(jnp.bfloat16),
-                            ok_.astype(jnp.bfloat16), cidb], axis=0)
+    def run(vals, lanes):
+        packed = jnp.stack([v.astype(jnp.bfloat16) for v in vals]
+                           + [cidb], axis=0)
         if pad:
             packed = jnp.pad(packed, ((0, 0), (0, pad)),
                              constant_values=-1)
         return hist_pallas_raw(bins8, packed, B=B, chunk=chunk,
-                               dtype="bf16v", lanes=lanes)
+                               dtype="bf16v", lanes=lanes,
+                               stats=len(vals))
 
+    lanes3 = LANES if num_cols <= 42 else 192
     if precision == "bf16":
-        acc = run(g, h, okf)
+        acc = run([g, h, okf], lanes3)
+    elif precision == "f32x1":
+        g_hi = g.astype(jnp.bfloat16).astype(jnp.float32)
+        h_hi = h.astype(jnp.bfloat16).astype(jnp.float32)
+        lanes5 = LANES if num_cols <= 25 else 192
+        acc5 = run([g_hi, g - g_hi, h_hi, h - h_hi, okf], lanes5)
+        w = acc5[:, :, :num_cols * 5].reshape(F, B, num_cols, 5)
+        hist = jnp.stack([w[..., 0] + w[..., 1], w[..., 2] + w[..., 3],
+                          w[..., 4]], axis=-1)
+        return hist.transpose(2, 0, 1, 3)
     elif precision == "f32x2":
         g_hi = g.astype(jnp.bfloat16).astype(jnp.float32)
         h_hi = h.astype(jnp.bfloat16).astype(jnp.float32)
-        acc = (run(g_hi, h_hi, okf)
-               + run(g - g_hi, h - h_hi, jnp.zeros_like(okf)))
+        acc = (run([g_hi, h_hi, okf], lanes3)
+               + run([g - g_hi, h - h_hi, jnp.zeros_like(okf)], lanes3))
     else:
         raise ValueError(f"unknown float-hist precision {precision!r}")
     hist = acc[:, :, :num_cols * 3]

@@ -161,7 +161,8 @@ def histogram_leafbatch(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                               axis_name=axis_name, int_reduce=int_reduce,
                               stochastic=stochastic, salt=salt)
     # float dtypes on TPU: hand-scheduled Pallas kernel with bf16 operands
-    # (f32 splits into two bf16 passes).  This routes AROUND the XLA
+    # (f32 rides a hi/lo operand split — one 5-stat pass for narrow
+    # levels, two 3-stat passes wider).  This routes AROUND the XLA
     # one-hot-einsum lowering, whose fast path regressed ~27x in this
     # environment (BASELINE.md round-3 addendum) — and is the faster
     # schedule even on a healthy runtime.  Width is handled inside the
@@ -171,7 +172,7 @@ def histogram_leafbatch(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     # hook, exactly like the einsum branch below.
     if _pallas_hist_ok(num_bins_max):
         from .hist_pallas import hist_pallas_float_leafbatch
-        precision = ("bf16" if compute_dtype == jnp.bfloat16 else "f32x2")
+        precision = ("bf16" if compute_dtype == jnp.bfloat16 else "f32")
         return hist_pallas_float_leafbatch(bins, grad, hess, col_id,
                                            col_ok, num_cols, num_bins_max,
                                            precision=precision)

@@ -158,3 +158,35 @@ def test_wide_dataset_feature_grid():
     assert got_f.shape == (C, F, B, 3)
     np.testing.assert_allclose(np.asarray(got_f), np.asarray(want_f),
                                rtol=1e-5, atol=1e-4)
+
+
+def test_f32x1_bit_identical_to_f32x2(hist_inputs):
+    """The single-pass 5-stat packing accumulates the same per-lane f32
+    partial sums as the two-pass variant — outputs must be bit-equal
+    (including across the 38-column grouping boundary)."""
+    from jax.experimental.pallas import tpu as pltpu
+    bins, grad, hess, cid, ok, F, N, B, C = hist_inputs
+    with pltpu.force_tpu_interpret_mode():
+        one = hist_pallas_float_leafbatch(bins, grad, hess, cid, ok, C, B,
+                                          chunk=1024, precision="f32x1")
+        two = hist_pallas_float_leafbatch(bins, grad, hess, cid, ok, C, B,
+                                          chunk=1024, precision="f32x2")
+    np.testing.assert_array_equal(np.asarray(one), np.asarray(two))
+
+    rng = np.random.RandomState(23)
+    for C2 in (32, 50):
+        # 32: the 192-lane single 5-stat pass (the depthwise depth-5
+        # production route, 192 % 5 leaves 2 partial lanes);
+        # 50: > 38, grouped into two 5-stat passes
+        cid2 = jnp.asarray(rng.randint(0, C2, N).astype(np.int32))
+        want = histogram_leafbatch_segsum(bins, grad, hess, cid2, ok,
+                                          C2, B)
+        with pltpu.force_tpu_interpret_mode():
+            got = hist_pallas_float_leafbatch(bins, grad, hess, cid2, ok,
+                                              C2, B, chunk=1024,
+                                              precision="f32x1")
+        assert got.shape == (C2, F, B, 3)
+        np.testing.assert_array_equal(np.asarray(want[..., 2]),
+                                      np.asarray(got[..., 2]))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-4, atol=1e-3)
