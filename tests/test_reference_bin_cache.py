@@ -233,3 +233,37 @@ def _reference_value_to_bin(upper, values):
         l = np.where(active & ~le, m + 1, l)
         active = l < r
     return l
+
+
+def test_reference_bin_multimachine_reshard(synth_dir, tmp_path):
+    """Distributed loading from a reference cache: every row lands on
+    exactly one machine (dataset.cpp:840-872 re-shard semantics, same
+    seeded assignment as our own cache loader), and each shard's
+    metadata/bins stay row-aligned.  The cache sits in a directory
+    WITHOUT the text file, so the silent re-bin fallback cannot mask a
+    parser regression — these loads either parse the reference format or
+    fatal."""
+    shutil.copy(synth_dir / "synth.tsv.bin", tmp_path / "synth.tsv.bin")
+    full = Dataset.load_train(
+        IOConfig(data_filename=str(tmp_path / "synth.tsv")))
+    M = 4
+    shards = [Dataset.load_train(
+        IOConfig(data_filename=str(tmp_path / "synth.tsv")),
+        rank=r, num_machines=M) for r in range(M)]
+    assert sum(s.num_data for s in shards) == full.num_data
+    for s in shards:
+        assert s.bins.shape == (s.num_features, s.num_data)
+        assert s.metadata.label.shape == (s.num_data,)
+        assert s.global_num_data == full.num_data
+    # same seed => same assignment across loads; shard labels partition
+    # the full label multiset
+    all_labels = np.sort(np.concatenate(
+        [np.asarray(s.metadata.label) for s in shards]))
+    np.testing.assert_array_equal(all_labels,
+                                  np.sort(np.asarray(full.metadata.label)))
+    # pre-partition mode loads everything everywhere
+    pre = Dataset.load_train(
+        IOConfig(data_filename=str(tmp_path / "synth.tsv"),
+                 is_pre_partition=True),
+        rank=1, num_machines=M)
+    assert pre.num_data == full.num_data
