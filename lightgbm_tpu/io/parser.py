@@ -27,6 +27,8 @@ import numpy as np
 
 from ..utils import log
 
+_WARNED_NO_PANDAS = False
+
 ZERO_THRESHOLD = 1e-10  # parser.hpp:32
 
 
@@ -307,6 +309,15 @@ def _parse_delimited_pandas(lines: List[str], delimiter: str):
         import io as _io
         import pandas as pd
     except ImportError:
+        # reached only when the native tier already bowed out: the load is
+        # about to drop to the exact per-token loop (orders of magnitude
+        # slower on big text files) — say so once
+        global _WARNED_NO_PANDAS
+        if not _WARNED_NO_PANDAS:
+            _WARNED_NO_PANDAS = True
+            log.warning(
+                "pandas unavailable: text parsing falls back to the exact "
+                "per-token tier (slow); pip install 'lightgbm-tpu[fast-parse]'")
         return None
     n_delim = lines[0].count(delimiter)
     if any(ln.count(delimiter) != n_delim for ln in lines):
