@@ -278,6 +278,16 @@ class TreeConfig:
     # dispatches (runtime watchdogs, interactivity).  Default 1 = the
     # whole tree in one dispatch.
     leafwise_segments: int = 1
+    # compacted leaf-wise growth (TreeConfig extension, grow_policy=
+    # leafwise, serial learner only): keep every leaf's rows physically
+    # contiguous (the reference's DataPartition asymptotic,
+    # data_partition.hpp:93-139, recast as data movement — see
+    # models/grower_leafcompact.py) so each split histograms only the
+    # smaller child's rows instead of sweeping all N.  "auto" (default)
+    # = on when the backend is TPU, off elsewhere (keeps CPU-golden
+    # tests on the masked grower); "true"/"false" force it.  When on it
+    # subsumes leafwise_segments: per-tree dispatches are already short.
+    leafwise_compact: str = "auto"
     # int8 rounding mode: "nearest" (default) or "stochastic" — unbiased
     # floor(y+u) with deterministic value-keyed uniform bits
     # (ops/hist_pallas.stochastic_bits); preserves the serial==distributed
@@ -319,6 +329,11 @@ class TreeConfig:
                                           self.leafwise_segments)
         log.check(self.leafwise_segments >= 1,
                   "leafwise_segments should be >= 1")
+        if "leafwise_compact" in params:
+            value = params["leafwise_compact"].lower()
+            log.check(value in ("auto", "true", "false"),
+                      "leafwise_compact must be auto, true or false")
+            self.leafwise_compact = value
         if "dp_schedule" in params:
             value = params["dp_schedule"].lower()
             log.check(value in ("psum", "reduce_scatter"),

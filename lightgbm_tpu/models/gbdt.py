@@ -1395,6 +1395,17 @@ def _serial_learner(gbdt: GBDT, bins, grad, hess, row_mask, feature_mask):
         return grow_tree_depthwise_jit(bins, grad, hess, row_mask,
                                        feature_mask, gbdt.num_bins_device,
                                        **kwargs)
+    compact = getattr(gbdt.tree_config, "leafwise_compact", "auto")
+    if compact == "auto":
+        compact = ("true" if jax.default_backend() == "tpu" else "false")
+    if compact == "true":
+        # compacted growth subsumes leafwise_segments: each split touches
+        # only the smaller child's rows, so whole-tree dispatches stay
+        # short even at bench scale (grower_leafcompact.py)
+        from .grower_leafcompact import grow_tree_leafcompact
+        return grow_tree_leafcompact(
+            bins, grad, hess, row_mask, feature_mask, gbdt.num_bins_device,
+            use_pallas_partition=jax.default_backend() == "tpu", **kwargs)
     segments = getattr(gbdt.tree_config, "leafwise_segments", 1)
     if segments > 1:
         from .grower import grow_tree_segmented

@@ -1,0 +1,223 @@
+"""Streaming stable row-partition — the compacted leaf-wise grower's core op.
+
+The reference keeps every leaf's rows CONTIGUOUS in a permuted index array
+and partitions the parent's range at each split
+(/root/reference/src/io/data_partition.hpp:93-139); its histogram then
+touches only the leaf's own rows (dense_bin.hpp:46-112 ConstructHistogram
+over an ordered index list).  A TPU can't follow row indices (XLA lowers
+small-table gathers to per-row scalar addressing — measured ~85 ms per [N]
+f32 gather at 11M rows, PROFILE.md), so this module moves the DATA instead
+of the indices: the [R, N] int8 plane matrix (bin rows + grad/hess
+bit-planes + validity) is kept physically partitioned, and each split
+stably partitions the parent's lane range in one streaming sweep.
+
+The Pallas kernel (TPU): grid = (2 passes, lane blocks), sequential.  Pass
+0 compacts the left rows, pass 1 the right rows — two sweeps so a later
+left write can never clobber earlier right data.  Per block the lane
+compaction is pure MXU: an exclusive prefix-sum of the selection mask via
+a strict-lower-triangular int8 matmul, a one-hot selection matrix built by
+an iota compare, and an int8 x int8 -> int32 selection matmul that moves
+whole [R, block] panes (f32 grad/hess travel bit-exactly as 4 int8
+planes).  The compacted block is DMA'd to the output at a running lane
+offset carried in SMEM; consecutive writes overlap-overwrite each other's
+tails, so every write is a full aligned block.  Cost per partitioned row:
+block x R int8 MACs + ~3 bytes of HBM traffic per plane — ~0.4% of the
+histogram MACs the compaction saves (PROFILE.md).
+
+The XLA oracle (CPU/tests): a stable argsort formulation with identical
+semantics — the kernel is differentially tested against it.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+BLOCK = 2048  # partition lane block: [R<=64, 2048] int8 panes + a [2048,
+              # 2048] int8 selection matrix = ~4.3 MB VMEM
+
+
+def _partition_kernel(mask_ref, scal_ref, seg_ref, out_ref, win_ref,
+                      offs_ref, sem_ref, *, R, block):
+    """Grid (nblocks,): both streams (left then right) per lane block.
+
+    Mosaic requires dynamic DMA lane offsets to be 128-aligned, so each
+    stream writes a read-modify-write WINDOW at the aligned-down offset:
+    the compacted rows are shifted to their exact in-window position by a
+    one-hot shift matmul, blended with the window's current content, and
+    the whole aligned window written back.  Fully serialized DMAs keep
+    the left write visible to the right read (their windows may
+    overlap)."""
+    j = pl.program_id(0)
+
+    @pl.when(j == 0)
+    def _():
+        offs_ref[0] = 0
+        offs_ref[1] = 0
+
+    delta = scal_ref[0]
+    plcnt = scal_ref[1]
+    win = block + 128
+
+    # mask3 lanes: 1 = left, 0 = right, -1 = outside the segment.  All
+    # compares/arithmetic run wide (int32) — Mosaic has no 8-bit vector
+    # math — and cast to int8 only at the MXU operands.
+    m = mask_ref[...].astype(jnp.int32)                    # [1, block]
+    iota_s = jax.lax.broadcasted_iota(jnp.int32, (block, block), 0)
+    iota_t = jax.lax.broadcasted_iota(jnp.int32, (win, block), 0)
+    lt = (iota_s < jax.lax.broadcasted_iota(
+        jnp.int32, (block, block), 1)).astype(jnp.int8)
+    lane_w = jax.lax.broadcasted_iota(jnp.int32, (R, win), 1)
+    pane = seg_ref[...]                                    # [R, block] int8
+
+    for p in (0, 1):
+        mi = (m == 1 - p).astype(jnp.int32)                # [1, block]
+        used = jnp.sum(mi)
+        # exclusive prefix sum over lanes as a strict-lower matmul
+        pos = jax.lax.dot_general(
+            mi.astype(jnp.int8), lt,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)              # [1, block]
+        # compact + shift in ONE one-hot matmul: source lane s lands at
+        # window lane pos[s] + shift
+        base = delta + p * plcnt + offs_ref[p]
+        p0 = (base // 128) * 128                           # aligned window
+        shift = base - p0
+        sel = ((jnp.broadcast_to(pos, (win, block)) + shift == iota_t)
+               & jnp.broadcast_to(mi == 1, (win, block))).astype(jnp.int8)
+        shifted = jax.lax.dot_general(
+            pane, sel, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32)              # [R, win] i32
+        # RMW: read the aligned window, blend lanes [shift, shift+used)
+        dma_in = pltpu.make_async_copy(
+            out_ref.at[:, pl.ds(p0, win)], win_ref, sem_ref)
+        dma_in.start()
+        dma_in.wait()
+        keep = ((lane_w >= shift) & (lane_w < shift + used)).astype(
+            jnp.int32)
+        blended = (shifted * keep
+                   + win_ref[...].astype(jnp.int32) * (1 - keep))
+        win_ref[...] = blended.astype(jnp.int8)
+        dma_out = pltpu.make_async_copy(
+            win_ref, out_ref.at[:, pl.ds(p0, win)], sem_ref)
+        dma_out.start()
+        dma_out.wait()
+        offs_ref[p] = offs_ref[p] + used
+
+
+@functools.partial(jax.jit, static_argnames=("block", "use_pallas",
+                                             "interpret"))
+def partition_segment(seg, mask3, delta, cnt, plcnt, *, block: int = BLOCK,
+                      use_pallas: bool = False, interpret: bool = False):
+    """Stable in-segment partition of ``seg``'s lanes [delta, delta+cnt).
+
+    seg : [R, W] int8 plane pane (W a multiple of ``block``)
+    mask3 : [W] int8 — 1 = goes left, 0 = goes right, -1 = outside the
+        segment (those lanes are preserved untouched)
+    delta, cnt, plcnt : i32 scalars — segment offset within the pane, its
+        lane count, and the number of mask3==1 lanes
+
+    Returns the pane with lanes [delta, delta+plcnt) holding the left rows
+    in original relative order, [delta+plcnt, delta+cnt) the right rows,
+    everything else byte-identical to the input.
+    """
+    R, W = seg.shape
+    assert W % block == 0, (W, block)
+    lane = jnp.arange(W, dtype=jnp.int32)
+    inseg = (lane >= delta) & (lane < delta + cnt)
+
+    if use_pallas:
+        scal = jnp.stack([delta, plcnt]).astype(jnp.int32)
+        out = pl.pallas_call(
+            functools.partial(_partition_kernel, R=R, block=block),
+            grid=(W // block,),
+            in_specs=[
+                pl.BlockSpec((1, block), lambda j: (0, j)),
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec((R, block), lambda j: (0, j)),
+            ],
+            # HBM, not ANY: Mosaic may place ANY in VMEM, where dynamic
+            # DMA lane offsets (128-aligned here) are disallowed
+            out_specs=pl.BlockSpec(memory_space=pltpu.HBM),
+            out_shape=jax.ShapeDtypeStruct((R, W + block + 256), jnp.int8),
+            scratch_shapes=[
+                pltpu.VMEM((R, block + 128), jnp.int8),
+                pltpu.SMEM((2,), jnp.int32),
+                pltpu.SemaphoreType.DMA(()),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("arbitrary",)),
+            interpret=interpret,
+        )(mask3[None, :], scal, seg)
+        return jnp.where(inseg[None, :], out[:, :W], seg)
+
+    # XLA oracle: stable sort by class (left 0, right 1, outside 2) puts
+    # left+right compacted at the FRONT of the sorted pane; rolling by
+    # ``delta`` aligns them with the segment's true position
+    keys = jnp.where(mask3 == 1, 0, jnp.where(mask3 == 0, 1, 2))
+    order = jnp.argsort(keys, stable=True)
+    permuted = jnp.roll(jnp.take(seg, order, axis=1), delta, axis=1)
+    return jnp.where(inseg[None, :], permuted, seg)
+
+
+def pane_rows(num_features: int) -> int:
+    """Plane-pane row count: F bin rows + 8 grad/hess bit-plane rows +
+    validity, padded to the int8 sublane tile (Mosaic requires slices
+    along the sublane dim to be 8-aligned)."""
+    r = num_features + 9
+    return -(-r // 8) * 8
+
+
+def pack_planes(bins, grad, hess, row_mask, width: int) -> jax.Array:
+    """[pane_rows(F), width] int8 plane pane: bin rows, grad/hess as 4
+    int8 bit-planes each (bit-exact f32 transport through the int8
+    selection matmul), validity, zero rows up to the sublane tile.  Lane
+    padding beyond N is garbage — every consumer masks by segment
+    extent."""
+    F, N = bins.shape
+    planes = [jax.lax.bitcast_convert_type(bins.astype(jnp.uint8),
+                                           jnp.int8)]
+    for v in (grad, hess):
+        u = jax.lax.bitcast_convert_type(v.astype(jnp.float32), jnp.uint32)
+        for k in range(4):
+            planes.append(jax.lax.bitcast_convert_type(
+                ((u >> (8 * k)) & 0xFF).astype(jnp.uint8), jnp.int8))
+    planes.append(row_mask.astype(jnp.int8))
+    pane = jnp.concatenate(
+        [p if p.ndim == 2 else p[None, :] for p in planes], axis=0)
+    return jnp.pad(pane, ((0, pane_rows(F) - (F + 9)), (0, width - N)))
+
+
+def unpack_values(pane_slice, F: int):
+    """(bins uint8 [F, W], grad f32 [W], hess f32 [W], valid bool [W])
+    from a plane-pane slice."""
+    bins = jax.lax.bitcast_convert_type(pane_slice[:F], jnp.uint8)
+
+    def f32_of(rows):
+        u = jnp.zeros(pane_slice.shape[1:], jnp.uint32)
+        for k in range(4):
+            b = jax.lax.bitcast_convert_type(rows[k], jnp.uint8)
+            u = u | (b.astype(jnp.uint32) << (8 * k))
+        return jax.lax.bitcast_convert_type(u, jnp.float32)
+
+    grad = f32_of(pane_slice[F:F + 4])
+    hess = f32_of(pane_slice[F + 4:F + 8])
+    valid = pane_slice[F + 8] == 1
+    return bins, grad, hess, valid
+
+
+def bucket_table(n: int, block: int = BLOCK, min_width: int = 0):
+    """Descending static slice widths W_0 > W_1 > ... >= max(block,
+    min_width): W_0 covers the root, each next is ceil(W/2) rounded up to a
+    block multiple (so a physically-smaller child of a bucket-k parent
+    always fits bucket k+1)."""
+    w = -(-n // block) * block
+    floor_w = max(block, -(-min_width // block) * block)
+    table = [w]
+    while table[-1] > floor_w:
+        w = -(-(table[-1] // 2) // block) * block
+        table.append(max(w, floor_w))
+    return tuple(table)

@@ -1,0 +1,277 @@
+"""Compacted leaf-wise grower: streaming partition op + tree equivalence.
+
+The compacted grower (models/grower_leafcompact.py) must grow EXACTLY the
+trees of the masked grower (models/grower.py) — same structure, and
+bit-identical values in the int8 mode whose arithmetic is order-free.  The
+partition op itself is differentially tested: Pallas kernel (interpret
+mode on CPU) vs the stable-argsort XLA oracle.
+"""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from lightgbm_tpu.ops.compact import (BLOCK, bucket_table, pack_planes,
+                                      partition_segment, unpack_values)
+
+
+def _random_case(rng, R, W, delta, cnt):
+    seg = rng.randint(-128, 128, (R, W)).astype(np.int8)
+    m = rng.randint(0, 2, W).astype(np.int8)
+    lane = np.arange(W)
+    mask3 = np.where((lane >= delta) & (lane < delta + cnt), m, -1)
+    return seg, mask3.astype(np.int8), int((mask3 == 1).sum())
+
+
+@pytest.mark.parametrize("delta,cnt", [
+    (0, 4096), (0, 4000), (100, 3000), (4095, 1), (0, 1), (123, 0),
+])
+def test_partition_kernel_matches_oracle(delta, cnt):
+    rng = np.random.RandomState(delta + cnt)
+    R, W = 11, 4096
+    seg, mask3, plcnt = _random_case(rng, R, W, delta, cnt)
+    args = (jnp.asarray(seg), jnp.asarray(mask3), jnp.int32(delta),
+            jnp.int32(cnt), jnp.int32(plcnt))
+    oracle = np.asarray(partition_segment(*args, block=2048))
+    kernel = np.asarray(partition_segment(*args, block=2048,
+                                          use_pallas=True, interpret=True))
+    np.testing.assert_array_equal(oracle, kernel)
+
+
+def test_partition_oracle_semantics():
+    """Stable partition of the in-segment lanes; everything else
+    preserved byte for byte."""
+    rng = np.random.RandomState(3)
+    R, W, delta, cnt = 5, 8192, 777, 6000
+    seg, mask3, plcnt = _random_case(rng, R, W, delta, cnt)
+    out = np.asarray(partition_segment(
+        jnp.asarray(seg), jnp.asarray(mask3), jnp.int32(delta),
+        jnp.int32(cnt), jnp.int32(plcnt)))
+    m = mask3[delta:delta + cnt]
+    inner = seg[:, delta:delta + cnt]
+    np.testing.assert_array_equal(out[:, delta:delta + plcnt],
+                                  inner[:, m == 1])
+    np.testing.assert_array_equal(out[:, delta + plcnt:delta + cnt],
+                                  inner[:, m == 0])
+    np.testing.assert_array_equal(out[:, :delta], seg[:, :delta])
+    np.testing.assert_array_equal(out[:, delta + cnt:], seg[:, delta + cnt:])
+
+
+def test_plane_pack_roundtrip():
+    rng = np.random.RandomState(1)
+    N, F = 1000, 4
+    bins = rng.randint(0, 256, (F, N)).astype(np.uint8)
+    grad = rng.randn(N).astype(np.float32) * 1e3
+    hess = np.abs(rng.randn(N)).astype(np.float32) * 1e-3
+    mask = rng.rand(N) < 0.7
+    from lightgbm_tpu.ops.compact import pane_rows
+    pane = pack_planes(jnp.asarray(bins), jnp.asarray(grad),
+                       jnp.asarray(hess), jnp.asarray(mask), 2048)
+    assert pane.shape == (pane_rows(F), 2048)
+    assert pane_rows(F) % 8 == 0
+    b, g, h, v = unpack_values(pane[:, :N], F)
+    np.testing.assert_array_equal(np.asarray(b), bins)
+    np.testing.assert_array_equal(np.asarray(g), grad)   # bit-exact planes
+    np.testing.assert_array_equal(np.asarray(h), hess)
+    np.testing.assert_array_equal(np.asarray(v), mask)
+
+
+def test_bucket_table_invariants():
+    for n in (1, 2048, 100_000, 1_000_000, 11_000_000):
+        t = bucket_table(n)
+        assert t[0] >= n and t[0] % BLOCK == 0
+        for a, b in zip(t, t[1:]):
+            assert b % BLOCK == 0 and b < a
+            # a tier-k child (<= ceil(parent/2) rows) fits tier k+1
+            assert b >= -(-a // 2) - BLOCK
+
+
+def _grow_both(seed, *, compute_dtype, bagging, num_leaves=31, N=4000,
+               F=5, B=32, min_data=20):
+    from lightgbm_tpu.models.grower import grow_tree
+    from lightgbm_tpu.models.grower_leafcompact import grow_tree_leafcompact
+
+    rng = np.random.RandomState(seed)
+    x = rng.randn(N, F)
+    lo, hi = x.min(0), x.max(0)
+    bins = np.clip((x - lo) / (hi - lo) * (B - 1), 0, B - 1)
+    bins = bins.astype(np.uint8).T
+    y = (x[:, 0] - x[:, 1] + 0.5 * np.sin(3 * x[:, 2])
+         + 0.3 * rng.randn(N) > 0)
+    pr = np.full(N, 0.5, np.float32)
+    grad = (pr - y).astype(np.float32)
+    hess = (pr * (1 - pr)).astype(np.float32)
+    row_mask = np.ones(N, bool)
+    if bagging:
+        row_mask[rng.rand(N) < 0.4] = False
+    kw = dict(num_leaves=num_leaves, num_bins_max=B,
+              min_data_in_leaf=min_data, min_sum_hessian_in_leaf=1e-3,
+              compute_dtype=compute_dtype)
+    args = (jnp.asarray(bins), jnp.asarray(grad), jnp.asarray(hess),
+            jnp.asarray(row_mask), jnp.asarray(np.ones(F, bool)),
+            jnp.asarray(np.full(F, B, np.int32)))
+    return grow_tree(*args, **kw), grow_tree_leafcompact(*args, **kw)
+
+
+@pytest.mark.parametrize("bagging", [False, True])
+@pytest.mark.parametrize("dtype", ["int8", "float32"])
+def test_compact_grower_matches_masked_grower(dtype, bagging):
+    dt = "int8" if dtype == "int8" else jnp.float32
+    t1, t2 = _grow_both(11, compute_dtype=dt, bagging=bagging)
+    assert int(t1.num_leaves) == int(t2.num_leaves) > 8
+    for field in ("split_feature", "threshold_bin", "left_child",
+                  "right_child", "leaf_count", "leaf_ids"):
+        np.testing.assert_array_equal(np.asarray(getattr(t1, field)),
+                                      np.asarray(getattr(t2, field)),
+                                      err_msg=field)
+    if dtype == "float32":
+        # no trailing dequantize multiply -> nothing for XLA CPU's FMA
+        # contraction to grab: bit-identical across the two programs
+        np.testing.assert_array_equal(np.asarray(t1.leaf_value),
+                                      np.asarray(t2.leaf_value))
+    else:
+        # XLA CPU contracts the MASKED grower's int8 dequantize multiply
+        # into the subtraction as a single-rounding FMA (sub-ulp dust the
+        # compacted program doesn't get; see grower_leafcompact.py) —
+        # value-tolerant here, with the bitwise anchor provided by
+        # test_compact_grower_matches_jitfree_replay
+        np.testing.assert_allclose(np.asarray(t1.leaf_value),
+                                   np.asarray(t2.leaf_value),
+                                   rtol=1e-4, atol=1e-7)
+
+
+def _manual_replay(bins, grad, hess, row_mask, num_bins, feature_mask, *,
+                   num_leaves, num_bins_max, min_data, min_hess, dtype):
+    """jit-free leaf-wise replay: the same library ops (build_histogram /
+    find_best_split), dispatched one by one so no cross-op fusion can
+    alter rounding.  The reference algorithm in ~30 lines
+    (serial_tree_learner.cpp:119-153)."""
+    from lightgbm_tpu.ops.histogram import build_histogram
+    from lightgbm_tpu.ops.split import find_best_split
+
+    N = bins.shape[1]
+    bj, gj, hj = map(jnp.asarray, (bins, grad, hess))
+    nb, fm = jnp.asarray(num_bins), jnp.asarray(feature_mask)
+    leaf_ids = np.zeros(N, np.int32)
+    hist, cand = {}, {}
+    root = np.asarray(build_histogram(bj, gj, hj, jnp.asarray(row_mask),
+                                      num_bins_max, compute_dtype=dtype))
+    if dtype == "int8":
+        st = root[0].sum(axis=0)
+    else:
+        st = np.array([(grad * row_mask).sum(), (hess * row_mask).sum(),
+                       row_mask.sum()], np.float32)
+    hist[0] = root
+    cand[0] = find_best_split(jnp.asarray(root), *map(jnp.float32, st),
+                              nb, fm, float(min_data), float(min_hess))
+    values = np.zeros(num_leaves, np.float32)
+    for split in range(num_leaves - 1):
+        bl = max(cand, key=lambda k: float(cand[k].gain))
+        best = cand[bl]
+        if not float(best.gain) > 0:
+            break
+        new = split + 1
+        feat, thr = int(best.feature), int(best.threshold)
+        go_r = (bins[feat] > thr) & (leaf_ids == bl)
+        leaf_ids[go_r] = new
+        lcnt, rcnt = int(best.left_count), int(best.right_count)
+        small = bl if lcnt <= rcnt else new
+        sm = row_mask & (leaf_ids == small)
+        sh = np.asarray(build_histogram(bj, gj, hj, jnp.asarray(sm),
+                                        num_bins_max, compute_dtype=dtype,
+                                        salt=new))
+        large = hist[bl] - sh
+        hist[bl], hist[new] = ((sh, large) if lcnt <= rcnt
+                               else (large, sh))
+        values[bl] = float(best.left_output)
+        values[new] = float(best.right_output)
+        for leaf, g_, h_, c_ in ((bl, best.left_sum_grad,
+                                  best.left_sum_hess, lcnt),
+                                 (new, best.right_sum_grad,
+                                  best.right_sum_hess, rcnt)):
+            cand[leaf] = find_best_split(
+                jnp.asarray(hist[leaf]), jnp.float32(g_), jnp.float32(h_),
+                jnp.float32(c_), nb, fm, float(min_data), float(min_hess))
+    return leaf_ids, values
+
+
+@pytest.mark.parametrize("dtype", ["int8", "float32"])
+def test_compact_grower_matches_jitfree_replay(dtype):
+    """The compacted grower reproduces a jit-free op-by-op replay of the
+    reference algorithm BIT FOR BIT — the strongest equivalence anchor
+    available on CPU (the masked grower deviates by FMA-contraction dust
+    in the int8 mode; the replay and the compacted program do not)."""
+    from lightgbm_tpu.models.grower_leafcompact import grow_tree_leafcompact
+
+    rng = np.random.RandomState(23)
+    N, F, B, L = 4000, 5, 32, 15
+    x = rng.randn(N, F)
+    lo, hi = x.min(0), x.max(0)
+    bins = np.clip((x - lo) / (hi - lo) * (B - 1), 0, B - 1)
+    bins = bins.astype(np.uint8).T
+    y = (x[:, 0] - x[:, 1] + 0.3 * rng.randn(N) > 0)
+    pr = np.full(N, 0.5, np.float32)
+    grad = (pr - y).astype(np.float32)
+    hess = (pr * (1 - pr)).astype(np.float32)
+    row_mask = np.ones(N, bool)
+    row_mask[rng.rand(N) < 0.3] = False
+    nb = np.full(F, B, np.int32)
+    fm = np.ones(F, bool)
+    dt = "int8" if dtype == "int8" else jnp.float32
+
+    tree = grow_tree_leafcompact(
+        jnp.asarray(bins), jnp.asarray(grad), jnp.asarray(hess),
+        jnp.asarray(row_mask), jnp.asarray(fm), jnp.asarray(nb),
+        num_leaves=L, num_bins_max=B, min_data_in_leaf=20,
+        min_sum_hessian_in_leaf=1e-3, compute_dtype=dt)
+    leaf_ids, values = _manual_replay(
+        bins, grad, hess, row_mask, nb, fm, num_leaves=L, num_bins_max=B,
+        min_data=20, min_hess=1e-3,
+        dtype="int8" if dtype == "int8" else jnp.float32)
+    np.testing.assert_array_equal(np.asarray(tree.leaf_ids), leaf_ids)
+    nl = int(tree.num_leaves)
+    np.testing.assert_array_equal(np.asarray(tree.leaf_value)[:nl],
+                                  values[:nl])
+
+
+def test_compact_training_end_to_end():
+    """Config-driven training with leafwise_compact=true reproduces the
+    masked grower's boosting trajectory: identical tree structure every
+    iteration, leaf values to reduction-order rounding (real-gradient
+    [N]-sum reductions fuse differently across the two compiled programs
+    on CPU — the bitwise anchor is test_compact_grower_matches_jitfree_
+    replay)."""
+    from lightgbm_tpu.config import OverallConfig
+    from lightgbm_tpu.io.dataset import Dataset
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.objectives import create_objective
+
+    rng = np.random.RandomState(5)
+    N = 3000
+    x = rng.randn(N, 6)
+    y = ((x[:, 0] + 0.5 * x[:, 1] * x[:, 2] + 0.3 * rng.randn(N)) > 0)
+    ds = Dataset.from_arrays(x, y.astype(np.float32), max_bin=64)
+
+    def run(compact):
+        cfg = OverallConfig()
+        cfg.set({"objective": "binary", "num_leaves": "15",
+                 "min_data_in_leaf": "20", "min_sum_hessian_in_leaf": "1e-3",
+                 "learning_rate": "0.1", "num_iterations": "5",
+                 "grow_policy": "leafwise", "hist_dtype": "float32",
+                 "leafwise_compact": compact}, require_data=False)
+        b = GBDT()
+        b.init(cfg.boosting_config, ds,
+               create_objective(cfg.objective_type, cfg.objective_config))
+        for _ in range(5):
+            b.train_one_iter(is_eval=False)
+        return b
+
+    b1, b2 = run("false"), run("true")
+    assert len(b1.models) == len(b2.models) == 5
+    for t1, t2 in zip(b1.models, b2.models):
+        assert t1.num_leaves == t2.num_leaves
+        np.testing.assert_array_equal(t1.split_feature, t2.split_feature)
+        np.testing.assert_array_equal(t1.threshold_bin, t2.threshold_bin)
+        np.testing.assert_allclose(t1.leaf_value, t2.leaf_value,
+                                   rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(b1.score),
+                               np.asarray(b2.score), rtol=1e-3, atol=1e-5)
