@@ -210,6 +210,7 @@ def grow_tree_leafcompact_impl(bins, grad, hess, row_mask, feature_mask,
 
     def make_branch(k: int):
         W = table[k]
+        H = table[min(k + 1, K - 1)]
 
         def branch(op):
             pane, start, cnt, feat, thr, salt, lcnt, rcnt = op
@@ -234,18 +235,38 @@ def grow_tree_leafcompact_impl(bins, grad, hess, row_mask, feature_mask,
             # directly-histogrammed child: the VALID-smaller side, exactly
             # like the masked grower — so the two growers' direct/
             # subtracted assignment (and with it f32 dequantize rounding)
-            # matches bit for bit.  The pass runs over the parent's OWN
-            # partitioned segment (already in hand as new_seg) with the
-            # chosen child's lane range masked: either side fits the
-            # parent width unconditionally, at ~2x the MACs of a
-            # half-width slice — still the geometric-series total
+            # matches bit for bit.  Common case (always, without bagging):
+            # the chosen side's physical span <= ceil(cnt/2) fits the NEXT
+            # tier's width H, so the pass sweeps a half-width slice;
+            # bagging skew can push the valid-smaller side's span past H,
+            # falling back to the parent-width segment already in hand.
+            # Same rows in the same relative order either way (zero-lane
+            # padding differs only) — bit-identical histograms
             prcnt = cnt - plcnt
             left_small = lcnt <= rcnt
             scnt = jnp.where(left_small, plcnt, prcnt)
-            d2 = jnp.where(left_small, delta, delta + plcnt)
-            hbins, hg, hh, hvalid = unpack_values(new_seg, F)
-            hmask = (lane >= d2) & (lane < d2 + scnt) & hvalid
-            shist = hist_of(hbins, hg, hh, hmask, salt=salt)
+            sstart = jnp.where(left_small, start, start + plcnt)
+
+            def hist_half(_):
+                cs2 = jnp.minimum(sstart, P - H)
+                d2 = sstart - cs2
+                hseg = jax.lax.dynamic_slice(pane2, (jnp.int32(0), cs2),
+                                             (R, H))
+                hbins, hg, hh, hvalid = unpack_values(hseg, F)
+                lane2 = jnp.arange(H, dtype=jnp.int32)
+                hmask = (lane2 >= d2) & (lane2 < d2 + scnt) & hvalid
+                return hist_of(hbins, hg, hh, hmask, salt=salt)
+
+            def hist_full(_):
+                d2 = sstart - cs
+                hbins, hg, hh, hvalid = unpack_values(new_seg, F)
+                hmask = (lane >= d2) & (lane < d2 + scnt) & hvalid
+                return hist_of(hbins, hg, hh, hmask, salt=salt)
+
+            if H == W:
+                shist = hist_full(None)
+            else:
+                shist = jax.lax.cond(scnt <= H, hist_half, hist_full, None)
             return pane2, plcnt, left_small, shist
 
         return branch
