@@ -269,7 +269,10 @@ class TreeConfig:
     # and allreduce the packed SplitInfo: ~half the collective bytes and
     # 1/S of the split-search compute per level.  Applies to the fused
     # depthwise data-parallel chunk; identical trees either way.
-    dp_schedule: str = "psum"
+    # "auto" resolves at learner creation: true multi-process runs take
+    # reduce_scatter (the reference's N-machine mode IS that schedule);
+    # single-process meshes keep psum (parallel/learners.py _schedule)
+    dp_schedule: str = "auto"
     # leaf-wise dispatch segmentation (TreeConfig extension, grow_policy=
     # leafwise only): a 255-leaf leaf-wise tree is 254 sequential
     # histogram passes in ONE XLA dispatch; >1 splits that loop across N
@@ -336,8 +339,8 @@ class TreeConfig:
             self.leafwise_compact = value
         if "dp_schedule" in params:
             value = params["dp_schedule"].lower()
-            log.check(value in ("psum", "reduce_scatter"),
-                      "dp_schedule must be psum or reduce_scatter")
+            log.check(value in ("auto", "psum", "reduce_scatter"),
+                      "dp_schedule must be auto, psum or reduce_scatter")
             self.dp_schedule = value
         if "quant_rounding" in params:
             value = params["quant_rounding"].lower()

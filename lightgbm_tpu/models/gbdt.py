@@ -94,14 +94,15 @@ class GBDT:
         self.sigmoid = objective.sigmoid if objective is not None else -1.0
         self._learner = learner or _serial_learner
         if (learner is not None
-                and getattr(self.tree_config, "leafwise_segments", 1) > 1):
-            # the parallel learners drive grow_tree_impl inside their own
-            # (shard_map) programs; the dispatch-segmentation seam only
-            # exists on the serial path, so say so instead of silently
-            # running the whole tree as one dispatch
-            log.warning("leafwise_segments applies to the serial tree "
-                        "learner only; ignored for %s"
-                        % type(learner).__name__)
+                and getattr(self.tree_config, "leafwise_segments", 1) > 1
+                and not getattr(learner, "supports_leafwise_segments",
+                                False)):
+            # the data-parallel learner segments its shard_map'd split
+            # loop (learners._segmented_grow); the feature-parallel one
+            # still runs whole-tree dispatches — say so instead of
+            # silently ignoring the setting
+            log.warning("leafwise_segments is not supported by %s; "
+                        "ignored" % type(learner).__name__)
 
         N = train_data.num_data
         self.num_bins_max = int(train_data.num_bins.max())

@@ -97,9 +97,10 @@ def grow_tree_impl(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                    min_data_in_leaf: int, min_sum_hessian_in_leaf: float,
                    max_depth: int = -1, hist_backend: str = "matmul",
                    hist_chunk: int = 16384, compute_dtype=jnp.float32,
-                   hist_reduce=None, hist_axis=None,
+                   hist_reduce=None, hist_axis=None, int_hist_reduce=None,
                    split_finder=None, partition_bins=None,
-                   stat_reduce=None, init_state=None, loop_count=None,
+                   stat_reduce=None, own_slice=None, root_hist_reduce=None,
+                   init_state=None, loop_count=None,
                    return_state: bool = False):
     """Core grower (not jitted; callers wrap it).
 
@@ -116,7 +117,15 @@ def grow_tree_impl(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     num_bins : [F] i32 real bin counts
     hist_reduce : optional callable hist→hist; the data-parallel learner
         passes ``lambda h: psum(h, 'data')`` (the ReduceScatter+Allgather
-        contract of data_parallel_tree_learner.cpp:135-165)
+        contract of data_parallel_tree_learner.cpp:135-165).  Under the
+        reduce_scatter ownership schedule it is instead a feature-block
+        psum_scatter, so every histogram (and the cache) holds only this
+        shard's OWNED feature block — the split_finder must then be the
+        owned-search + SplitInfo-allreduce composite and feature_mask /
+        num_bins the owned slices (learners._scatter_grow_fn_leafwise)
+    int_hist_reduce : optional int-domain feature-block scatter for the
+        quantized path (forwarded to build_histogram's int_reduce so the
+        accumulators never leave the exact int domain)
     split_finder : optional callable with find_best_split's signature; the
         feature-parallel learner wraps it with the packed SplitInfo argmax
         allreduce (feature_parallel_tree_learner.cpp:46-79) and must return
@@ -145,9 +154,12 @@ def grow_tree_impl(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         hist = build_histogram(bins, grad, hess, mask, B,
                                backend=hist_backend, chunk=hist_chunk,
                                compute_dtype=compute_dtype,
-                               axis_name=hist_axis, salt=salt)
+                               axis_name=hist_axis,
+                               int_reduce=int_hist_reduce, salt=salt)
         # the quantized path reduces its INT accumulators internally over
-        # hist_axis (bit-exactness; ops/hist_pallas.quantize_values)
+        # hist_axis (bit-exactness; ops/hist_pallas.quantize_values) —
+        # psum by default, the ownership feature-block scatter when
+        # int_hist_reduce is set
         if hist_reduce is not None and not (
                 str(compute_dtype).startswith("int8")
                 and hist_axis is not None):
@@ -167,14 +179,33 @@ def grow_tree_impl(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     # ---- root init (BeforeTrain, serial_tree_learner.cpp:155-236);
     # skipped entirely when resuming from a carried state (segmentation)
     def _root_state() -> _GrowState:
-        root_hist = hist_of(row_mask)
+        if own_slice is not None:
+            # ownership (reduce_scatter) schedule: build the ROOT
+            # replicated — full F, plain psum — so root stats are exact on
+            # every shard including feature-PADDING shards (whose owned
+            # block is all zeros), then cache only the owned slice.  The
+            # depthwise scatter path does the same (learners.py own_slice).
+            full = build_histogram(bins, grad, hess, row_mask, B,
+                                   backend=hist_backend, chunk=hist_chunk,
+                                   compute_dtype=compute_dtype,
+                                   axis_name=hist_axis)
+            if root_hist_reduce is not None and not (
+                    str(compute_dtype).startswith("int8")
+                    and hist_axis is not None):
+                full = root_hist_reduce(full)
+            root_hist = own_slice(full)
+        else:
+            full = root_hist = hist_of(row_mask)
         if str(compute_dtype).startswith("int8"):
             # quantized mode: derive root stats from the histogram — the
             # int accumulators are bit-identical across serial/
             # data-parallel (see grower_depthwise.py root-stat note), and
             # any feature's bins sum to the same exact quantized totals, so
             # this also holds under feature-parallel ownership slices
-            root_stats = jnp.sum(root_hist[0], axis=0)
+            # (``full``: under the reduce_scatter schedule the stats must
+            # come from the replicated full-F root, not the owned block —
+            # a feature-padding shard's block is all zeros)
+            root_stats = jnp.sum(full[0], axis=0)
         else:
             # root sums come from the gradient vectors, not from any one
             # feature's histogram: per-feature f32 bin-order rounding would
@@ -209,7 +240,8 @@ def grow_tree_impl(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         )
         return _GrowState(
             tree=tree,
-            hist_cache=jnp.zeros((L, F, B, 3), f32).at[0].set(root_hist),
+            hist_cache=jnp.zeros((L,) + root_hist.shape,
+                                 f32).at[0].set(root_hist),
             cand_gain=neg_inf.at[0].set(root_best.gain),
             cand_feature=zeros_i.at[0].set(root_best.feature),
             cand_threshold=zeros_i.at[0].set(root_best.threshold),

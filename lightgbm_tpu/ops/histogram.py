@@ -310,7 +310,11 @@ def histogram_segsum(bins: jax.Array, grad: jax.Array, hess: jax.Array,
 def build_histogram(bins, grad, hess, mask, num_bins_max, *,
                     backend: str = "matmul", chunk: int = 16384,
                     compute_dtype=jnp.float32, axis_name=None,
-                    salt=0) -> jax.Array:
+                    int_reduce=None, salt=0) -> jax.Array:
+    """``int_reduce``: optional int-domain cross-shard reduction for the
+    quantized path (feature axis 0) — the data-parallel reduce_scatter
+    ownership schedule passes a psum_scatter here so the accumulators are
+    scattered WITHOUT leaving the exact int domain."""
     if str(compute_dtype).startswith("int8"):
         # single-leaf quantized pass == leaf-batched with one column
         N = bins.shape[1]
@@ -318,7 +322,8 @@ def build_histogram(bins, grad, hess, mask, num_bins_max, *,
         out = histogram_leafbatch(bins, grad, hess, cid, mask, 1,
                                   num_bins_max, chunk=chunk,
                                   compute_dtype=compute_dtype,
-                                  axis_name=axis_name, salt=salt)
+                                  axis_name=axis_name,
+                                  int_reduce=int_reduce, salt=salt)
         return out[0]
     if backend == "matmul":
         if _pallas_hist_ok(num_bins_max):

@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from ..models.grower import TreeArrays, grow_tree_impl
+from ..models.grower import TreeArrays, _GrowState, grow_tree_impl
 from ..models.grower_depthwise import grow_tree_depthwise
 from ..models.gbdt import _effective_num_leaves, _tuning_kwargs
 from ..ops.split import SplitResult, find_best_split
@@ -138,6 +138,70 @@ class DataParallelLearner(_ParallelLearnerBase):
       bytes per level and divides split-search compute by the shard
       count; trees are identical (bit-identical under int8)."""
 
+    def _schedule(self) -> str:
+        """Resolve dp_schedule: 'auto' (the config default) follows the
+        reference — its N-machine data-parallel mode IS the ReduceScatter
+        ownership schedule (data_parallel_tree_learner.cpp:135-235) — so
+        true multi-process runs default to reduce_scatter, while
+        single-process meshes keep psum (simplest, measured equivalent at
+        small shard counts, PROFILE.md)."""
+        s = getattr(self.tree_config, "dp_schedule", "psum")
+        if s == "auto":
+            return ("reduce_scatter" if jax.process_count() > 1
+                    else "psum")
+        return s
+
+    def _scatter_grow_fn_leafwise(self, kwargs, F: int, num_shards: int):
+        """Per-shard leaf-wise grow closure for the reduce_scatter
+        ownership schedule: every histogram (smaller child per split) is
+        psum_scatter'd by contiguous feature block — int domain for the
+        quantized path — the hist cache holds only the owned block, the
+        split search runs on owned features, and the packed SplitInfo
+        allreduce picks the global winner.  This is the reference's
+        N-machine mode in its native growth order
+        (data_parallel_tree_learner.cpp:135-235 driving
+        serial_tree_learner.cpp:119-153)."""
+        Fb = -(-F // num_shards)
+        Fpad = Fb * num_shards
+
+        def shard_grow(bins_s, grad_s, hess_s, mask_s, fmask, nbins,
+                       **extra):
+            rank = jax.lax.axis_index(DATA_AXIS)
+            idx = rank * Fb + jnp.arange(Fb, dtype=jnp.int32)
+            ownok = idx < F
+            own_s = jnp.minimum(idx, F - 1)
+            fmask_own = fmask[own_s] & ownok
+            nbins_own = jnp.take(nbins, own_s)
+
+            def pad_f(x):
+                if Fpad == F:
+                    return x
+                widths = [(0, 0)] * x.ndim
+                widths[0] = (0, Fpad - F)
+                return jnp.pad(x, widths)
+
+            def scatter0(h):
+                # per-split [F, B, ...] histogram (f32) or [F, B, lanes]
+                # INT accumulator — both carry features on axis 0
+                return jax.lax.psum_scatter(
+                    pad_f(h), DATA_AXIS, scatter_dimension=0, tiled=True)
+
+            def own_slice(h):
+                return jax.lax.dynamic_slice_in_dim(
+                    pad_f(h), rank * Fb, Fb, axis=0)
+
+            return grow_tree_impl(
+                bins_s, grad_s, hess_s, mask_s, fmask_own, nbins_own,
+                hist_reduce=scatter0, int_hist_reduce=scatter0,
+                hist_axis=DATA_AXIS,
+                stat_reduce=lambda s: jax.lax.psum(s, DATA_AXIS),
+                root_hist_reduce=lambda h: jax.lax.psum(h, DATA_AXIS),
+                own_slice=own_slice,
+                split_finder=ownership_finder(own_s, DATA_AXIS),
+                partition_bins=bins_s,
+                **kwargs, **extra)
+        return shard_grow
+
     def _scatter_grow_fn(self, grow, kwargs, F: int, num_shards: int):
         """Per-shard grow closure for the reduce_scatter schedule."""
         Fb = -(-F // num_shards)
@@ -220,10 +284,9 @@ class DataParallelLearner(_ParallelLearnerBase):
         depthwise = self._depthwise
         n_true = gbdt.num_data
         max_nodes = max(_effective_num_leaves(self.tree_config) - 1, 1)
-        # reduce_scatter applies to the fused depthwise chunk (the
-        # leaf-wise per-iteration path keeps psum)
-        use_scatter = (getattr(self.tree_config, "dp_schedule", "psum")
-                       == "reduce_scatter" and depthwise)
+        # reduce_scatter in the fused depthwise chunk; the leaf-wise
+        # per-iteration path has its own scatter closure (__call__)
+        use_scatter = self._schedule() == "reduce_scatter" and depthwise
         num_features = gbdt.num_features
         key = (obj_key, id(grad_fn), num_shards, num_class, lr, depthwise,
                tuple(sorted(kwargs.items())), has_bag, has_ff, n_true,
@@ -333,6 +396,93 @@ class DataParallelLearner(_ParallelLearnerBase):
         _DP_CHUNK_PROGRAMS[key] = prog
         return prog, num_shards
 
+    # the dispatch-segmentation seam (grower.grow_tree_segmented) exists
+    # under this learner: distributed leaf-wise training survives
+    # per-dispatch execution watchdogs at bench scale (VERDICT r4 #4)
+    supports_leafwise_segments = True
+
+    def _grow_fn(self, kwargs, F: int, num_shards: int):
+        """Per-shard leaf-wise grow closure for the active schedule."""
+        if self._schedule() == "reduce_scatter":
+            return self._scatter_grow_fn_leafwise(kwargs, F, num_shards)
+
+        def shard_grow(bins_s, grad_s, hess_s, mask_s, fmask, nbins,
+                       **extra):
+            return grow_tree_impl(
+                bins_s, grad_s, hess_s, mask_s, fmask, nbins,
+                hist_reduce=lambda h: jax.lax.psum(h, DATA_AXIS),
+                stat_reduce=lambda s: jax.lax.psum(s, DATA_AXIS),
+                hist_axis=DATA_AXIS,
+                **kwargs, **extra)
+        return shard_grow
+
+    def _state_specs(self):
+        """shard_map specs of the carried _GrowState: leaf_ids row-sharded,
+        the hist cache feature-sharded under the ownership schedule (each
+        shard holds its owned block), everything else replicated."""
+        cache = (P(None, DATA_AXIS)
+                 if self._schedule() == "reduce_scatter" else P())
+        rep = P()
+        return _GrowState(
+            tree=_tree_out_specs(DATA_AXIS), hist_cache=cache,
+            cand_gain=rep, cand_feature=rep, cand_threshold=rep,
+            cand_left_out=rep, cand_right_out=rep, cand_left_cnt=rep,
+            cand_right_cnt=rep, cand_left_g=rep, cand_left_h=rep,
+            cand_right_g=rep, cand_right_h=rep, leaf_sum_g=rep,
+            leaf_sum_h=rep, leaf_cnt=rep, leaf_depth=rep, done=rep)
+
+    def _segmented_grow(self, gbdt, bins, grad, hess, row_mask,
+                        feature_mask, mesh, num_shards, segments: int):
+        """grow_tree_segmented under shard_map: the split fori_loop runs as
+        ceil((L-1)/segments) dispatches with the _GrowState carried
+        device-resident (and donated) between them — program-identical
+        trees, just short dispatches, exactly like the serial seam.  The
+        reference's N-machine leaf-wise mode has no dispatch-length
+        constraint to start with (serial_tree_learner.cpp:119-153); this
+        restores that property under runtime watchdogs."""
+        F, _ = bins.shape
+        kwargs = self._grow_kwargs(gbdt)
+        L = kwargs["num_leaves"]
+        total = max(L - 1, 1)
+        per = -(-total // max(segments, 1))
+        cache = getattr(self, "_seg_progs", None)
+        if cache is None or cache[0] != (F, num_shards, per):
+            grow_fn = self._grow_fn(kwargs, F, num_shards)
+            in_specs = (P(None, DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+                        P(DATA_AXIS), P(), P())
+            sspec = self._state_specs()
+
+            def shard_init(bins_s, grad_s, hess_s, mask_s, fmask, nbins):
+                return grow_fn(bins_s, grad_s, hess_s, mask_s, fmask,
+                               nbins, loop_count=0, return_state=True)
+
+            init_p = jax.jit(shard_map(shard_init, mesh=mesh,
+                                       in_specs=in_specs, out_specs=sspec))
+            seg_ps = {}
+            for n in {per, total - per * (total // per)} - {0}:
+                def shard_seg(bins_s, grad_s, hess_s, mask_s, fmask,
+                              nbins, state, _n=n):
+                    return grow_fn(bins_s, grad_s, hess_s, mask_s, fmask,
+                                   nbins, init_state=state, loop_count=_n,
+                                   return_state=True)
+                seg_ps[n] = jax.jit(
+                    shard_map(shard_seg, mesh=mesh,
+                              in_specs=in_specs + (sspec,),
+                              out_specs=sspec),
+                    donate_argnums=(6,))
+            cache = ((F, num_shards, per), init_p, seg_ps)
+            self._seg_progs = cache
+        _, init_p, seg_ps = cache
+        args = (bins, grad, hess, row_mask, feature_mask,
+                gbdt.num_bins_device)
+        state = init_p(*args)
+        done = 0
+        while done < total:
+            n = min(per, total - done)
+            state = seg_ps[n](*args, state)
+            done += n
+        return state.tree
+
     def __call__(self, gbdt, bins, grad, hess, row_mask, feature_mask):
         mesh = get_mesh(self.config.network_config.num_machines, DATA_AXIS,
                         getattr(self.config, 'device_type', ''))
@@ -345,17 +495,33 @@ class DataParallelLearner(_ParallelLearnerBase):
             hess = jnp.pad(hess, (0, pad))
             row_mask = jnp.pad(row_mask, (0, pad))
 
+        segments = getattr(self.tree_config, "leafwise_segments", 1)
+        if not self._depthwise and segments > 1:
+            tree = self._segmented_grow(gbdt, bins, grad, hess, row_mask,
+                                        feature_mask, mesh, num_shards,
+                                        segments)
+            if pad:
+                tree = tree._replace(leaf_ids=tree.leaf_ids[:N])
+            return tree
+
         if self._jitted is None:
             kwargs = self._grow_kwargs(gbdt)
-            grow = grow_tree_depthwise if self._depthwise else grow_tree_impl
+            if (not self._depthwise
+                    and self._schedule() == "reduce_scatter"):
+                # leaf-wise under the reference's ownership schedule
+                shard_fn = self._scatter_grow_fn_leafwise(
+                    kwargs, F, num_shards)
+            else:
+                grow = (grow_tree_depthwise if self._depthwise
+                        else grow_tree_impl)
 
-            def shard_fn(bins_s, grad_s, hess_s, mask_s, fmask, nbins):
-                return grow(
-                    bins_s, grad_s, hess_s, mask_s, fmask, nbins,
-                    hist_reduce=lambda h: jax.lax.psum(h, DATA_AXIS),
-                    stat_reduce=lambda s: jax.lax.psum(s, DATA_AXIS),
-                    hist_axis=DATA_AXIS,
-                    **kwargs)
+                def shard_fn(bins_s, grad_s, hess_s, mask_s, fmask, nbins):
+                    return grow(
+                        bins_s, grad_s, hess_s, mask_s, fmask, nbins,
+                        hist_reduce=lambda h: jax.lax.psum(h, DATA_AXIS),
+                        stat_reduce=lambda s: jax.lax.psum(s, DATA_AXIS),
+                        hist_axis=DATA_AXIS,
+                        **kwargs)
 
             self._jitted = jax.jit(shard_map(
                 shard_fn, mesh=mesh,

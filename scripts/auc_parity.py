@@ -59,6 +59,11 @@ def main() -> int:
                     choices=["float32", "bfloat16", "int8"])
     ap.add_argument("--quant-rounding", default="nearest",
                     choices=["nearest", "stochastic"])
+    ap.add_argument("--max-bin", type=int, default=255,
+                    help="bin budget for BOTH sides (the reference's "
+                         "own default is 255; 63 is its documented "
+                         "speed configuration, config.h:137 — the "
+                         "quality gate must compare at matched budget)")
     args = ap.parse_args()
 
     x, y = make_data(args.rows + args.test_rows, 28)
@@ -66,7 +71,8 @@ def main() -> int:
     xte, yte = x[args.rows:], y[args.rows:]
 
     conf_common = dict(objective="binary", num_trees=args.iters,
-                       learning_rate="0.1", num_leaves="255", max_bin="255",
+                       learning_rate="0.1", num_leaves="255",
+                       max_bin=str(args.max_bin),
                        min_data_in_leaf="100",
                        min_sum_hessian_in_leaf="10.0")
 
@@ -77,7 +83,7 @@ def main() -> int:
     from lightgbm_tpu.models.gbdt import GBDT
     from lightgbm_tpu.objectives import create_objective
 
-    ds = Dataset.from_arrays(xtr, ytr, max_bin=255)
+    ds = Dataset.from_arrays(xtr, ytr, max_bin=args.max_bin)
     cfg = OverallConfig()
     cfg.set({**{k: str(v) for k, v in conf_common.items()},
              "num_iterations": str(args.iters),
@@ -106,7 +112,7 @@ def main() -> int:
     ours_scores = booster.predict_raw(xte)
     ours_auc = auc_manual(yte, ours_scores)
     print(f"ours[{args.grow_policy}/{args.hist_dtype}/"
-          f"{args.quant_rounding}]: "
+          f"{args.quant_rounding}/max_bin={args.max_bin}]: "
           f"{args.iters} iters in {t_ours:.1f}s "
           f"wall incl. jit compile (bench.py reports steady-state "
           f"throughput), test AUC {ours_auc:.6f}", flush=True)

@@ -441,3 +441,95 @@ def test_data_parallel_reduce_scatter_matches_psum(hist_dtype):
             np.testing.assert_allclose(t1.leaf_value, t2.leaf_value,
                                        rtol=1e-5, atol=1e-7,
                                        err_msg=f"tree {k}")
+
+
+@pytest.mark.parametrize("hist_dtype", ["int8", "float32"])
+def test_data_parallel_leafwise_reduce_scatter(hist_dtype):
+    """Leaf-wise growth under the reference's ReduceScatter ownership
+    schedule — its ACTUAL N-machine mode
+    (data_parallel_tree_learner.cpp:135-235 driving
+    serial_tree_learner.cpp:119-153): per-split smaller-child histograms
+    psum_scatter'd by feature block (int domain for int8), owned-feature
+    search, packed SplitInfo allreduce.  Must match serial trees and the
+    psum schedule; the dispatch-SEGMENTED variant (leafwise_segments=3,
+    VERDICT r4 #4) must match the one-dispatch variant.  F=10 is not
+    divisible by the 8-shard mesh, so one shard owns only feature
+    padding — the replicated-root-stat path."""
+    rng = np.random.RandomState(29)
+    n, f = 1999, 10
+    x = rng.randn(n, f)
+    y = ((x[:, 0] - 0.5 * x[:, 1] + 0.4 * rng.randn(n)) > 0)
+    ds = Dataset.from_arrays(x, y.astype(np.float32), max_bin=32)
+    params = {"objective": "binary", "num_leaves": 15,
+              "min_data_in_leaf": 20, "min_sum_hessian_in_leaf": 1.0,
+              "num_iterations": 4, "learning_rate": 0.2,
+              "grow_policy": "leafwise", "hist_dtype": hist_dtype,
+              "bagging_fraction": 0.8, "bagging_freq": 2, "bagging_seed": 5}
+
+    def make(tree_learner, **extra):
+        cfg = OverallConfig()
+        p = dict(params, tree_learner=tree_learner, **extra)
+        cfg.set({k: str(v) for k, v in p.items()}, require_data=False)
+        b = GBDT()
+        obj = create_objective(cfg.objective_type, cfg.objective_config)
+        learner = None
+        if tree_learner != "serial":
+            from lightgbm_tpu.parallel import create_parallel_learner
+            learner = create_parallel_learner(cfg)
+        b.init(cfg.boosting_config, ds, obj, learner=learner)
+        for _ in range(4):
+            b.train_one_iter(is_eval=False)
+        return b
+
+    b_serial = make("serial")
+    b_rs = make("data", num_machines=8, dp_schedule="reduce_scatter")
+    b_seg = make("data", num_machines=8, dp_schedule="reduce_scatter",
+                 leafwise_segments=3)
+    b_psum = make("data", num_machines=8, dp_schedule="psum")
+
+    for name, b in (("rs", b_rs), ("rs-seg", b_seg), ("psum", b_psum)):
+        assert len(b.models) == 4, name
+        for k, (t1, t2) in enumerate(zip(b_serial.models, b.models)):
+            assert t1.num_leaves == t2.num_leaves, f"{name} tree {k}"
+            np.testing.assert_array_equal(
+                t1.split_feature, t2.split_feature,
+                err_msg=f"{name} tree {k}")
+            np.testing.assert_array_equal(
+                t1.threshold_bin, t2.threshold_bin,
+                err_msg=f"{name} tree {k}")
+            # int8: int accumulators identical by construction, only the
+            # per-program f32 dequantize/search fusion may differ by an
+            # ulp; f32: psum reduction order differs from the serial sum
+            tol = dict(rtol=3e-7, atol=1e-9) if hist_dtype == "int8" \
+                else dict(rtol=1e-5, atol=1e-7)
+            np.testing.assert_allclose(t1.leaf_value, t2.leaf_value,
+                                       err_msg=f"{name} tree {k}", **tol)
+    # segmented == unsegmented: same shard closure, split loop cut into
+    # dispatches — trees must agree to the same per-program tolerance
+    for k, (t1, t2) in enumerate(zip(b_rs.models, b_seg.models)):
+        assert t1.num_leaves == t2.num_leaves, f"seg tree {k}"
+        np.testing.assert_array_equal(t1.split_feature, t2.split_feature)
+        np.testing.assert_array_equal(t1.threshold_bin, t2.threshold_bin)
+        np.testing.assert_allclose(t1.leaf_value, t2.leaf_value,
+                                   rtol=3e-7, atol=1e-9,
+                                   err_msg=f"seg tree {k}")
+
+
+def test_dp_schedule_auto_resolution(monkeypatch):
+    """dp_schedule=auto follows the reference: psum on a single-process
+    mesh, the ReduceScatter ownership schedule on true multi-process runs
+    (the reference's N-machine mode IS that schedule)."""
+    cfg = OverallConfig()
+    cfg.set({"objective": "binary", "tree_learner": "data",
+             "num_machines": "8"}, require_data=False)
+    assert cfg.boosting_config.tree_config.dp_schedule == "auto"
+    from lightgbm_tpu.parallel.learners import DataParallelLearner
+    learner = DataParallelLearner(cfg)
+    assert learner._schedule() == "psum"          # process_count() == 1
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    assert learner._schedule() == "reduce_scatter"
+    cfg2 = OverallConfig()
+    cfg2.set({"objective": "binary", "tree_learner": "data",
+              "num_machines": "8", "dp_schedule": "psum"},
+             require_data=False)
+    assert DataParallelLearner(cfg2)._schedule() == "psum"  # explicit wins
