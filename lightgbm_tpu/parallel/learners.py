@@ -506,22 +506,18 @@ class DataParallelLearner(_ParallelLearnerBase):
 
         if self._jitted is None:
             kwargs = self._grow_kwargs(gbdt)
-            if (not self._depthwise
-                    and self._schedule() == "reduce_scatter"):
-                # leaf-wise under the reference's ownership schedule
-                shard_fn = self._scatter_grow_fn_leafwise(
-                    kwargs, F, num_shards)
-            else:
-                grow = (grow_tree_depthwise if self._depthwise
-                        else grow_tree_impl)
-
+            if self._depthwise:
                 def shard_fn(bins_s, grad_s, hess_s, mask_s, fmask, nbins):
-                    return grow(
+                    return grow_tree_depthwise(
                         bins_s, grad_s, hess_s, mask_s, fmask, nbins,
                         hist_reduce=lambda h: jax.lax.psum(h, DATA_AXIS),
                         stat_reduce=lambda s: jax.lax.psum(s, DATA_AXIS),
                         hist_axis=DATA_AXIS,
                         **kwargs)
+            else:
+                # schedule-dispatching leaf-wise closure shared with the
+                # segmented path
+                shard_fn = self._grow_fn(kwargs, F, num_shards)
 
             self._jitted = jax.jit(shard_map(
                 shard_fn, mesh=mesh,

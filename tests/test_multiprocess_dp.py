@@ -631,3 +631,83 @@ def test_two_process_dp_weighted_regression_matches_serial(tmp_path):
         np.testing.assert_allclose(dp_vals[key], s_vals[key],
                                    rtol=2e-5, atol=1e-7,
                                    err_msg=f"metric {key}")
+
+
+def test_two_process_dp_continued_training_from_reference_model(
+        tmp_path, reference_binary):
+    """Continued training (``input_model``) under TRUE multi-process data
+    parallelism, seeded by a REFERENCE-WRITTEN model file — the
+    reference's own N-machine continued-training shape
+    (application.cpp:119-131 loading input_model + dataset.cpp:546-581
+    init scores): 2-OS-process DP continued run must stay in worker
+    lockstep and reproduce the serial continued run exactly (int8 +
+    psum)."""
+    rng = np.random.RandomState(44)
+    n, f = 1600, 8
+    x = rng.randn(n, f)
+    y = ((x[:, 0] - 0.5 * x[:, 1] + 0.2 * rng.randn(n)) > 0).astype(int)
+    csv = str(tmp_path / "train.csv")
+    np.savetxt(csv, np.column_stack([y, x]), fmt="%.7g", delimiter=",")
+
+    # 1) the reference binary trains the base model (3 trees)
+    base_model = str(tmp_path / "ref_base_model.txt")
+    with open(tmp_path / "ref_base.conf", "w") as fh:
+        fh.write(f"""task=train
+data={csv}
+objective=binary
+num_trees=3
+num_leaves=15
+min_data_in_leaf=20
+min_sum_hessian_in_leaf=1.0
+learning_rate=0.2
+max_bin=32
+output_model={base_model}
+""")
+    subprocess.run([reference_binary,
+                    f"config={tmp_path / 'ref_base.conf'}"],
+                   check=True, capture_output=True, text=True)
+    assert os.path.exists(base_model)
+
+    # 2) serial continued run: +5 trees on top of the reference model
+    extra = f"input_model={base_model}\n"
+    sconf = str(tmp_path / "cont_serial.conf")
+    _write_conf(sconf, csv, str(tmp_path / "model_serial.txt"), "serial",
+                1, num_iterations=5, extra=extra)
+    sp = _run(sconf)
+    sout, _ = sp.communicate(timeout=900)
+    assert sp.returncode == 0, f"serial failed:\n{sout[-4000:]}"
+
+    # 3) 2-process DP continued run, same input model
+    port = _free_port()
+    procs = []
+    for rank in range(2):
+        conf = str(tmp_path / f"cont_r{rank}.conf")
+        _write_conf(conf, csv, str(tmp_path / f"model_r{rank}.txt"),
+                    "data", 2, num_iterations=5, extra=extra)
+        procs.append(_run(conf, extra_env={
+            "LGBM_TPU_COORDINATOR": f"127.0.0.1:{port}",
+            "LGBM_TPU_NUM_PROCS": "2",
+            "LGBM_TPU_PROC_ID": str(rank),
+        }))
+    outs = [p.communicate(timeout=900)[0] for p in procs]
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out[-4000:]}"
+        assert "POST process_count: 2" in out
+
+    m0 = open(tmp_path / "model_r0.txt").read()
+    m1 = open(tmp_path / "model_r1.txt").read()
+    assert m0 == m1, "workers diverged"
+
+    trees_dp = _load_trees(str(tmp_path / "model_r0.txt"))
+    trees_s = _load_trees(str(tmp_path / "model_serial.txt"))
+    # 3 reference trees carried over + 5 continued
+    assert len(trees_dp) == len(trees_s) == 8
+    for k, (td, ts) in enumerate(zip(trees_dp, trees_s)):
+        assert td.num_leaves == ts.num_leaves, f"tree {k}"
+        np.testing.assert_array_equal(td.split_feature, ts.split_feature,
+                                      err_msg=f"tree {k}")
+        np.testing.assert_array_equal(td.threshold_bin, ts.threshold_bin,
+                                      err_msg=f"tree {k}")
+        np.testing.assert_allclose(td.leaf_value, ts.leaf_value,
+                                   rtol=1e-6, atol=1e-8,
+                                   err_msg=f"tree {k}")
