@@ -146,6 +146,10 @@ class IOConfig:
     is_enable_sparse: bool = True
     use_two_round_loading: bool = False
     is_save_binary_file: bool = False
+    # format of the is_save_binary_file cache: "native" (pickle header +
+    # raw bin matrix) or "reference" — the reference's own .bin layout
+    # (dataset.cpp:653-713), which its binary can train from directly
+    save_binary_format: str = "native"
     is_sigmoid: bool = True
     has_header: bool = False
     label_column: str = ""
@@ -170,6 +174,11 @@ class IOConfig:
                                                self.use_two_round_loading)
         self.is_save_binary_file = _get_bool(params, "is_save_binary_file",
                                              self.is_save_binary_file)
+        if "save_binary_format" in params:
+            value = params["save_binary_format"].lower()
+            log.check(value in ("native", "reference"),
+                      "save_binary_format must be native or reference")
+            self.save_binary_format = value
         self.is_sigmoid = _get_bool(params, "is_sigmoid", self.is_sigmoid)
         self.output_model = _get_str(params, "output_model", self.output_model)
         self.input_model = _get_str(params, "input_model", self.input_model)

@@ -160,7 +160,7 @@ class Dataset:
                 bin_finder, weight_idx, group_idx, ignore_set, header_names)
             self.metadata.finalize(self.num_data)
             if io_config.is_save_binary_file and not foreign_bin:
-                self.save_binary(bin_path)
+                self._save_binary_as(io_config, bin_path)
             return self
         lines = parser_mod.read_lines(io_config.data_filename,
                                       skip_header=io_config.has_header)
@@ -221,8 +221,17 @@ class Dataset:
 
         self._attach_init_score_values(features, predict_fun)
         if io_config.is_save_binary_file and not foreign_bin:
-            self.save_binary(bin_path)
+            self._save_binary_as(io_config, bin_path)
         return self
+
+    def _save_binary_as(self, io_config, bin_path: str) -> None:
+        """save_binary_format dispatch: "native" (default; pickle header +
+        raw bin matrix) or "reference" (the reference's own .bin layout —
+        its binary trains directly from our cache)."""
+        if io_config.save_binary_format == "reference":
+            self.save_binary_reference(bin_path)
+        else:
+            self.save_binary(bin_path)
 
     def _draw_shard_mask(self, io_config, rank, num_machines, total_rows):
         """Distributed row sharding at load time (dataset.cpp:172-216):
@@ -586,6 +595,89 @@ class Dataset:
                 f.write(len(blob).to_bytes(8, "little"))
                 f.write(blob)
                 f.write(np.ascontiguousarray(self.bins).tobytes())
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        log.info("Saved binary data file to %s" % path)
+
+    def save_binary_reference(self, path: str) -> None:
+        """Write the REFERENCE's binary cache layout
+        (Dataset::SaveBinaryFile, dataset.cpp:653-713) so the reference
+        binary can train directly from our cache — the write-side twin of
+        the native reader below.  Dense columns only (the reference's
+        loader picks DenseBin whenever the file says is_sparse=false,
+        bin.cpp:202-210; sparse delta-streams are a CPU cache layout with
+        no value in our matrix pipeline).
+
+        Layout quirk inherited from the reference: its own
+        Metadata::LoadFromMemory mis-advances past the label block when
+        queries are present WITHOUT weights (metadata.cpp:313 advances by
+        num_weights, not num_data) — a file we write with that shape is
+        byte-faithful to SaveBinaryFile yet unreadable by the reference's
+        own loader, exactly like the reference's own caches
+        (PARITY.md)."""
+        import struct
+
+        md = self.metadata
+        n = self.num_data
+        weights = md.weights
+        qb = md.query_boundaries
+        qw = getattr(md, "query_weights", None)
+        n_map = self.num_total_features
+        fmap = np.full(n_map, -1, dtype=np.int32)
+        for real, inner in self.used_feature_map.items():
+            fmap[real] = inner
+        names = list(self.feature_names)
+        if len(names) < n_map:
+            names += ["Column_%d" % i for i in range(len(names), n_map)]
+
+        header = b"".join(
+            [struct.pack("<Q", int(self.global_num_data or n)),
+             struct.pack("<?", False),          # is_enable_sparse
+             struct.pack("<iiii", int(self.max_bin), n,
+                         self.num_features, n_map),
+             struct.pack("<Q", n_map), fmap.tobytes()]
+            + [struct.pack("<i", len(s.encode())) + s.encode()
+               for s in names])
+
+        meta = [struct.pack("<iii", n,
+                            0 if weights is None else len(weights),
+                            0 if qb is None else len(qb) - 1),
+                np.asarray(md.label, "<f4").tobytes()]
+        if weights is not None:
+            meta.append(np.asarray(weights, "<f4").tobytes())
+        if qb is not None:
+            meta.append(np.asarray(qb, "<i4").tobytes())
+            if qw is not None:
+                meta.append(np.asarray(qw, "<f4").tobytes())
+        meta = b"".join(meta)
+
+        # inner features in REAL-index order, like features_ in the
+        # reference (construction order = real feature order)
+        tmp = path + ".%d.tmp" % os.getpid()
+        try:
+            with open(tmp, "wb") as f:
+                f.write(struct.pack("<Q", len(header)) + header)
+                f.write(struct.pack("<Q", len(meta)) + meta)
+                for real in self.real_feature_idx:
+                    inner = self.used_feature_map[int(real)]
+                    m = self.bin_mappers[inner]
+                    # single source of the <=256/<=65536 width rule
+                    vt = np.dtype(_bin_dtype(m.num_bin)).newbyteorder("<")
+                    blob = b"".join([
+                        struct.pack("<i?", int(real), False),  # dense
+                        struct.pack("<i?d", int(m.num_bin),
+                                    bool(m.is_trivial),
+                                    float(m.sparse_rate)),
+                        np.asarray(m.bin_upper_bound, "<f8").tobytes(),
+                        np.ascontiguousarray(
+                            self.bins[inner]).astype(vt).tobytes(),
+                    ])
+                    f.write(struct.pack("<Q", len(blob)) + blob)
             os.replace(tmp, path)
         except BaseException:
             try:
