@@ -145,14 +145,33 @@ def grow_tree_leafcompact_impl(bins, grad, hess, row_mask, feature_mask,
                                backend=hist_backend, chunk=hist_chunk,
                                compute_dtype=compute_dtype, salt=salt)
 
-    def best_of(hist, sum_g, sum_h, cnt, depth):
-        res = find_best_split(hist, sum_g, sum_h, cnt, num_bins,
-                              feature_mask, float(min_data_in_leaf),
-                              float(min_sum_hessian_in_leaf))
+    def _finder(hist, sum_g, sum_h, cnt):
+        return find_best_split(hist, sum_g, sum_h, cnt, num_bins,
+                               feature_mask, float(min_data_in_leaf),
+                               float(min_sum_hessian_in_leaf))
+
+    def _depth_gate(res, depth):
         if max_depth > 0:
-            blocked = depth >= max_depth
-            res = res._replace(gain=jnp.where(blocked, -jnp.inf, res.gain))
+            res = res._replace(gain=jnp.where(depth >= max_depth,
+                                              -jnp.inf, res.gain))
         return res
+
+    def best_of(hist, sum_g, sum_h, cnt, depth):
+        return _depth_gate(_finder(hist, sum_g, sum_h, cnt), depth)
+
+    def best_of_pair(lhist, rhist, lg, lh, lc, rg, rh, rc, depth):
+        """Both children's candidate searches in ONE batched finder call
+        (vmap over a [2, F, B, 3] stack): the finder's cumsum/argmax work
+        is tiny, so per-call XLA overhead — paid 2x per split otherwise —
+        is the cost that matters.  Elementwise math is identical to two
+        single calls (both children share the same depth)."""
+        both = _depth_gate(
+            jax.vmap(_finder)(jnp.stack([lhist, rhist]),
+                              jnp.stack([lg, rg]), jnp.stack([lh, rh]),
+                              jnp.stack([lc, rc])), depth)
+        lbest = jax.tree.map(lambda x: x[0], both)
+        rbest = jax.tree.map(lambda x: x[1], both)
+        return lbest, rbest
 
     # ---- root (BeforeTrain): full-data pass over the ORIGINAL arrays —
     # identical to grower.grow_tree's root, so the two growers share root
@@ -330,8 +349,9 @@ def grow_tree_leafcompact_impl(bins, grad, hess, row_mask, feature_mask,
             rg, rh = state.cand_right_g[bl], state.cand_right_h[bl]
             depth = state.leaf_depth[bl] + 1
 
-            lbest = best_of(lhist, lg, lh, lcnt.astype(f32), depth)
-            rbest = best_of(rhist, rg, rh, rcnt.astype(f32), depth)
+            lbest, rbest = best_of_pair(lhist, rhist, lg, lh,
+                                        lcnt.astype(f32), rg, rh,
+                                        rcnt.astype(f32), depth)
 
             tree = tree._replace(
                 num_leaves=nl + 1,
