@@ -275,3 +275,70 @@ def test_compact_training_end_to_end():
                                    rtol=1e-4, atol=1e-6)
     np.testing.assert_allclose(np.asarray(b1.score),
                                np.asarray(b2.score), rtol=1e-3, atol=1e-5)
+
+
+def test_compact_grower_max_depth():
+    """The depth guard must block splits identically in both growers."""
+    from lightgbm_tpu.models.grower import grow_tree
+    from lightgbm_tpu.models.grower_leafcompact import grow_tree_leafcompact
+
+    rng = np.random.RandomState(3)
+    N, F, B = 3000, 5, 32
+    x = rng.randn(N, F)
+    lo, hi = x.min(0), x.max(0)
+    bins = ((x - lo) / (hi - lo) * (B - 1)).astype(np.uint8).T
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0)
+    grad = (0.5 - y).astype(np.float32)
+    hess = np.full(N, 0.25, np.float32)
+    args = (jnp.asarray(bins), jnp.asarray(grad), jnp.asarray(hess),
+            jnp.asarray(np.ones(N, bool)), jnp.asarray(np.ones(F, bool)),
+            jnp.asarray(np.full(F, B, np.int32)))
+    kw = dict(num_leaves=31, num_bins_max=B, min_data_in_leaf=10,
+              min_sum_hessian_in_leaf=1e-3, max_depth=3,
+              compute_dtype=jnp.float32)
+    t1, t2 = grow_tree(*args, **kw), grow_tree_leafcompact(*args, **kw)
+    assert int(t1.num_leaves) == int(t2.num_leaves) <= 4   # 2^(3-1)
+    np.testing.assert_array_equal(np.asarray(t1.leaf_ids),
+                                  np.asarray(t2.leaf_ids))
+    np.testing.assert_array_equal(np.asarray(t1.leaf_value),
+                                  np.asarray(t2.leaf_value))
+
+
+def test_compact_training_multiclass():
+    """Multiclass boosting (per-class interleaved trees) through the
+    compacted grower matches the masked grower's structure/scores."""
+    from lightgbm_tpu.config import OverallConfig
+    from lightgbm_tpu.io.dataset import Dataset
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.objectives import create_objective
+
+    rng = np.random.RandomState(8)
+    N = 2400
+    x = rng.randn(N, 5)
+    y = (np.digitize(x[:, 0] + 0.3 * x[:, 1], [-0.5, 0.5])
+         ).astype(np.float32)
+    ds = Dataset.from_arrays(x, y, max_bin=32)
+
+    def run(compact):
+        cfg = OverallConfig()
+        cfg.set({"objective": "multiclass", "num_class": "3",
+                 "num_leaves": "7", "min_data_in_leaf": "20",
+                 "min_sum_hessian_in_leaf": "1e-3",
+                 "learning_rate": "0.1", "num_iterations": "3",
+                 "grow_policy": "leafwise", "hist_dtype": "float32",
+                 "leafwise_compact": compact}, require_data=False)
+        b = GBDT()
+        b.init(cfg.boosting_config, ds,
+               create_objective(cfg.objective_type, cfg.objective_config))
+        for _ in range(3):
+            b.train_one_iter(is_eval=False)
+        return b
+
+    b1, b2 = run("false"), run("true")
+    assert len(b1.models) == len(b2.models) == 9      # 3 classes x 3 iters
+    for t1, t2 in zip(b1.models, b2.models):
+        assert t1.num_leaves == t2.num_leaves
+        np.testing.assert_array_equal(t1.split_feature, t2.split_feature)
+        np.testing.assert_array_equal(t1.threshold_bin, t2.threshold_bin)
+        np.testing.assert_allclose(t1.leaf_value, t2.leaf_value,
+                                   rtol=1e-4, atol=1e-6)
