@@ -656,6 +656,7 @@ class GBDT:
                 hist_chunk=self.tree_config.hist_chunk,
                 hist_dtype=self.tree_config.hist_dtype,
                 quant_rounding=self.tree_config.quant_rounding,
+                leafwise_compact=leafwise_compact_on(self.tree_config),
                 has_bag=has_bag, has_ff=has_ff,
                 train_metric_fns=tuple(s[2] for s in train_specs),
                 valid_metric_fns=tuple(tuple(s[2] for s in specs)
@@ -1316,13 +1317,14 @@ def _get_chunk_program(obj_key, grad_fn, num_class: int, lr: float,
                        min_sum_hessian_in_leaf: float, max_depth: int,
                        hist_chunk: int = 0, hist_dtype: str = "float32",
                        quant_rounding: str = "nearest",
+                       leafwise_compact: bool = False,
                        has_bag: bool, has_ff: bool,
                        train_metric_fns: tuple = (),
                        valid_metric_fns: tuple = ()):
     key = (obj_key, id(grad_fn), num_class, lr, grow_policy, num_leaves,
            num_bins_max, min_data_in_leaf, min_sum_hessian_in_leaf,
-           max_depth, hist_chunk, hist_dtype, quant_rounding, has_bag,
-           has_ff,
+           max_depth, hist_chunk, hist_dtype, quant_rounding,
+           leafwise_compact, has_bag, has_ff,
            tuple(id(f) for f in train_metric_fns),
            tuple(tuple(id(f) for f in fns) for fns in valid_metric_fns))
     prog = _CHUNK_PROGRAMS.get(key)
@@ -1336,6 +1338,15 @@ def _get_chunk_program(obj_key, grad_fn, num_class: int, lr: float,
         **_tuning_kwargs(hist_chunk, hist_dtype, quant_rounding))
     if grow_policy == "depthwise":
         from .grower_depthwise import grow_tree_depthwise as grow
+    elif leafwise_compact:
+        # the resolved leafwise_compact flag keeps the chunk path (used
+        # by direct train_chunk calls — leaf-wise production training is
+        # per-iteration) on the SAME grower as the per-iteration path
+        import functools as _ft
+        from .grower_leafcompact import grow_tree_leafcompact_impl
+        grow = _ft.partial(
+            grow_tree_leafcompact_impl,
+            use_pallas_partition=jax.default_backend() == "tpu")
     else:
         from .grower import grow_tree_impl as grow
     lrf = jnp.float32(lr)
@@ -1379,6 +1390,19 @@ def _tuning_kwargs(hist_chunk: int, hist_dtype: str,
     return kwargs
 
 
+def leafwise_compact_on(tree_config) -> bool:
+    """Single home of the leafwise_compact resolution rule: "auto" means
+    on for the TPU backend (the compacted grower's Pallas partition is
+    TPU-scheduled; CPU keeps the masked grower so golden tests stay on
+    the historical path), explicit "true"/"false" win.  Shared by the
+    serial learner, both chunk-program builders, and the data-parallel
+    learner."""
+    c = getattr(tree_config, "leafwise_compact", "auto")
+    if c == "auto":
+        return jax.default_backend() == "tpu"
+    return c == "true"
+
+
 def _serial_learner(gbdt: GBDT, bins, grad, hess, row_mask, feature_mask):
     """Default learner: single-device tree growth, leaf-wise (reference
     parity) or depth-wise (TPU throughput) per ``grow_policy``."""
@@ -1396,10 +1420,7 @@ def _serial_learner(gbdt: GBDT, bins, grad, hess, row_mask, feature_mask):
         return grow_tree_depthwise_jit(bins, grad, hess, row_mask,
                                        feature_mask, gbdt.num_bins_device,
                                        **kwargs)
-    compact = getattr(gbdt.tree_config, "leafwise_compact", "auto")
-    if compact == "auto":
-        compact = ("true" if jax.default_backend() == "tpu" else "false")
-    if compact == "true":
+    if leafwise_compact_on(gbdt.tree_config):
         # compacted growth subsumes leafwise_segments: each split touches
         # only the smaller child's rows, so whole-tree dispatches stay
         # short even at bench scale (grower_leafcompact.py)

@@ -121,10 +121,22 @@ def grow_tree_leafcompact_impl(bins, grad, hess, row_mask, feature_mask,
                                compute_dtype=jnp.float32,
                                use_pallas_partition: bool = False,
                                interpret: bool = False,
+                               hist_reduce=None, hist_axis=None,
+                               stat_reduce=None,
                                return_state: bool = False):
     """Core (not jitted; callers wrap it).  ``return_state`` exposes the
     full _CompactState for differential debugging against
-    grower.grow_tree_impl's state."""
+    grower.grow_tree_impl's state.
+
+    hist_reduce/hist_axis/stat_reduce: the data-parallel (psum) seams,
+    same contract as grower.grow_tree_impl — each shard keeps its LOCAL
+    rows physically partitioned and the per-split histograms are reduced
+    globally.  Collectives may not sit inside per-shard-divergent
+    control flow, so the per-split work is TWO switches: the partition
+    switch (local, collective-free — each shard picks its own tier) and
+    the histogram switch, whose tier selector is pmax-synchronized
+    across shards (every shard takes the same branch, so the psum
+    inside it lines up)."""
     F, N = bins.shape
     R = pane_rows(F)            # plane-pane rows (ops/compact.pack_planes)
     L = num_leaves
@@ -141,9 +153,17 @@ def grow_tree_leafcompact_impl(bins, grad, hess, row_mask, feature_mask,
             jnp.int32)
 
     def hist_of(hbins, hg, hh, hmask, salt=0):
-        return build_histogram(hbins, hg, hh, hmask, B,
+        hist = build_histogram(hbins, hg, hh, hmask, B,
                                backend=hist_backend, chunk=hist_chunk,
-                               compute_dtype=compute_dtype, salt=salt)
+                               compute_dtype=compute_dtype,
+                               axis_name=hist_axis, salt=salt)
+        # the quantized path reduces its INT accumulators internally over
+        # hist_axis (grower.grow_tree_impl's rule, kept identical)
+        if hist_reduce is not None and not (
+                str(compute_dtype).startswith("int8")
+                and hist_axis is not None):
+            hist = hist_reduce(hist)
+        return hist
 
     def _finder(hist, sum_g, sum_h, cnt):
         return find_best_split(hist, sum_g, sum_h, cnt, num_bins,
@@ -185,6 +205,8 @@ def grow_tree_leafcompact_impl(bins, grad, hess, row_mask, feature_mask,
         maskf = row_mask.astype(f32)
         root_stats = jnp.stack([jnp.sum(grad * maskf),
                                 jnp.sum(hess * maskf), jnp.sum(maskf)])
+        if stat_reduce is not None:
+            root_stats = stat_reduce(root_stats)
     root_g, root_h, root_c = root_stats[0], root_stats[1], root_stats[2]
     root_best = best_of(root_hist, root_g, root_h, root_c,
                         jnp.asarray(1, jnp.int32))
@@ -227,12 +249,11 @@ def grow_tree_leafcompact_impl(bins, grad, hess, row_mask, feature_mask,
         done=jnp.asarray(False),
     )
 
-    def make_branch(k: int):
+    def make_partition_branch(k: int):
         W = table[k]
-        H = table[min(k + 1, K - 1)]
 
         def branch(op):
-            pane, start, cnt, feat, thr, salt, lcnt, rcnt = op
+            pane, start, cnt, feat, thr = op
             cs = jnp.minimum(start, P - W)        # clamp: slice stays
             delta = start - cs                    # in-pane; mask realigns
             seg = jax.lax.dynamic_slice(pane, (jnp.int32(0), cs), (R, W))
@@ -250,47 +271,28 @@ def grow_tree_leafcompact_impl(bins, grad, hess, row_mask, feature_mask,
                                         interpret=interpret)
             pane2 = jax.lax.dynamic_update_slice(pane, new_seg,
                                                  (jnp.int32(0), cs))
-
-            # directly-histogrammed child: the VALID-smaller side, exactly
-            # like the masked grower — so the two growers' direct/
-            # subtracted assignment (and with it f32 dequantize rounding)
-            # matches bit for bit.  Common case (always, without bagging):
-            # the chosen side's physical span <= ceil(cnt/2) fits the NEXT
-            # tier's width H, so the pass sweeps a half-width slice;
-            # bagging skew can push the valid-smaller side's span past H,
-            # falling back to the parent-width segment already in hand.
-            # Same rows in the same relative order either way (zero-lane
-            # padding differs only) — bit-identical histograms
-            prcnt = cnt - plcnt
-            left_small = lcnt <= rcnt
-            scnt = jnp.where(left_small, plcnt, prcnt)
-            sstart = jnp.where(left_small, start, start + plcnt)
-
-            def hist_half(_):
-                cs2 = jnp.minimum(sstart, P - H)
-                d2 = sstart - cs2
-                hseg = jax.lax.dynamic_slice(pane2, (jnp.int32(0), cs2),
-                                             (R, H))
-                hbins, hg, hh, hvalid = unpack_values(hseg, F)
-                lane2 = jnp.arange(H, dtype=jnp.int32)
-                hmask = (lane2 >= d2) & (lane2 < d2 + scnt) & hvalid
-                return hist_of(hbins, hg, hh, hmask, salt=salt)
-
-            def hist_full(_):
-                d2 = sstart - cs
-                hbins, hg, hh, hvalid = unpack_values(new_seg, F)
-                hmask = (lane >= d2) & (lane < d2 + scnt) & hvalid
-                return hist_of(hbins, hg, hh, hmask, salt=salt)
-
-            if H == W:
-                shist = hist_full(None)
-            else:
-                shist = jax.lax.cond(scnt <= H, hist_half, hist_full, None)
-            return pane2, plcnt, left_small, shist
+            return pane2, plcnt
 
         return branch
 
-    branches = [make_branch(k) for k in range(K)]
+    def make_hist_branch(k: int):
+        W = table[k]
+
+        def branch(op):
+            pane2, sstart, scnt, salt = op
+            cs2 = jnp.minimum(sstart, P - W)
+            d2 = sstart - cs2
+            hseg = jax.lax.dynamic_slice(pane2, (jnp.int32(0), cs2),
+                                         (R, W))
+            hbins, hg, hh, hvalid = unpack_values(hseg, F)
+            lane2 = jnp.arange(W, dtype=jnp.int32)
+            hmask = (lane2 >= d2) & (lane2 < d2 + scnt) & hvalid
+            return hist_of(hbins, hg, hh, hmask, salt=salt)
+
+        return branch
+
+    partition_branches = [make_partition_branch(k) for k in range(K)]
+    hist_branches = [make_hist_branch(k) for k in range(K)]
 
     def body(_, state: _CompactState) -> _CompactState:
         best_leaf = jnp.argmax(state.cand_gain).astype(jnp.int32)
@@ -326,15 +328,31 @@ def grow_tree_leafcompact_impl(bins, grad, hess, row_mask, feature_mask,
             leaf_ids = jnp.where((tree.leaf_ids == bl) & (obin > thr),
                                  new_leaf, tree.leaf_ids)
 
-            # --- partition the parent's lane range + smaller-child
-            # histogram, at the parent's static width tier
+            # --- partition the parent's lane range at ITS tier (local,
+            # collective-free: shards may take different branches)
             start = state.seg_start[bl]
             cnt = state.seg_cnt[bl]
-            pane2, plcnt, left_small, small_hist = jax.lax.switch(
-                state.seg_bucket[bl], branches,
-                (state.pane, start, cnt, feat, thr, new_leaf,
-                 state.cand_left_cnt[bl], state.cand_right_cnt[bl]))
+            pane2, plcnt = jax.lax.switch(
+                state.seg_bucket[bl], partition_branches,
+                (state.pane, start, cnt, feat, thr))
             prcnt = cnt - plcnt
+
+            # --- smaller-child histogram at the CHILD's own tier.  The
+            # directly-built side is the VALID-smaller one, exactly like
+            # the masked grower (same direct/subtracted f32 rounding);
+            # its physical span picks the slice tier — pmax-synced across
+            # shards so the collectives inside the branch line up
+            lcnt = state.cand_left_cnt[bl]
+            rcnt = state.cand_right_cnt[bl]
+            left_small = lcnt <= rcnt
+            scnt = jnp.where(left_small, plcnt, prcnt)
+            sstart = jnp.where(left_small, start, start + plcnt)
+            hk_span = scnt
+            if hist_axis is not None:
+                hk_span = jax.lax.pmax(hk_span, hist_axis)
+            small_hist = jax.lax.switch(
+                bucket_of(hk_span), hist_branches,
+                (pane2, sstart, scnt, new_leaf))
 
             parent_hist = state.hist_cache[bl]
             large_hist = parent_hist - small_hist
@@ -343,8 +361,6 @@ def grow_tree_leafcompact_impl(bins, grad, hess, row_mask, feature_mask,
             hist_cache = (state.hist_cache.at[bl].set(lhist)
                           .at[new_leaf].set(rhist))
 
-            lcnt = state.cand_left_cnt[bl]
-            rcnt = state.cand_right_cnt[bl]
             lg, lh = state.cand_left_g[bl], state.cand_left_h[bl]
             rg, rh = state.cand_right_g[bl], state.cand_right_h[bl]
             depth = state.leaf_depth[bl] + 1

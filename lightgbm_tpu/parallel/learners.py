@@ -287,17 +287,33 @@ class DataParallelLearner(_ParallelLearnerBase):
         # reduce_scatter in the fused depthwise chunk; the leaf-wise
         # per-iteration path has its own scatter closure (__call__)
         use_scatter = self._schedule() == "reduce_scatter" and depthwise
+        use_compact = (not depthwise
+                       and self._schedule() == "psum"
+                       and self._leafwise_compact_enabled())
         num_features = gbdt.num_features
         key = (obj_key, id(grad_fn), num_shards, num_class, lr, depthwise,
                tuple(sorted(kwargs.items())), has_bag, has_ff, n_true,
-               shard_layout, needs_global_score, use_scatter, num_features,
+               shard_layout, needs_global_score, use_scatter, use_compact,
+               num_features,
                tuple(id(f) for f in train_metric_fns),
                tuple(tuple(id(f) for f in fns) for fns in valid_metric_fns))
         prog = _DP_CHUNK_PROGRAMS.get(key)
         if prog is not None:
             return prog, num_shards
 
-        grow = grow_tree_depthwise if depthwise else grow_tree_impl
+        if depthwise:
+            grow = grow_tree_depthwise
+        elif use_compact:
+            # same grower on the chunk path as on __call__'s
+            # per-iteration path for the same config
+            import functools as _ft
+            from ..models.grower_leafcompact import (
+                grow_tree_leafcompact_impl)
+            grow = _ft.partial(
+                grow_tree_leafcompact_impl,
+                use_pallas_partition=jax.default_backend() == "tpu")
+        else:
+            grow = grow_tree_impl
         lrf = jnp.float32(lr)
 
         def gathered(f):
@@ -401,6 +417,30 @@ class DataParallelLearner(_ParallelLearnerBase):
     # per-dispatch execution watchdogs at bench scale (VERDICT r4 #4)
     supports_leafwise_segments = True
 
+    def _leafwise_compact_enabled(self) -> bool:
+        from ..models.gbdt import leafwise_compact_on
+        return leafwise_compact_on(self.tree_config)
+
+    def _compact_grow_fn(self, kwargs):
+        """Per-shard COMPACTED leaf-wise closure (psum schedule): each
+        shard keeps its local rows physically partitioned
+        (grower_leafcompact.py) and the per-split smaller-child
+        histograms are psum'd — distributed parity-mode training at the
+        geometric-series cost instead of full sweeps.  The histogram
+        tier is pmax-synced inside the grower so the collectives stay
+        uniform across shards."""
+        from ..models.grower_leafcompact import grow_tree_leafcompact_impl
+
+        def shard_grow(bins_s, grad_s, hess_s, mask_s, fmask, nbins):
+            return grow_tree_leafcompact_impl(
+                bins_s, grad_s, hess_s, mask_s, fmask, nbins,
+                hist_reduce=lambda h: jax.lax.psum(h, DATA_AXIS),
+                stat_reduce=lambda s: jax.lax.psum(s, DATA_AXIS),
+                hist_axis=DATA_AXIS,
+                use_pallas_partition=jax.default_backend() == "tpu",
+                **kwargs)
+        return shard_grow
+
     def _grow_fn(self, kwargs, F: int, num_shards: int):
         """Per-shard leaf-wise grow closure for the active schedule."""
         if self._schedule() == "reduce_scatter":
@@ -495,8 +535,15 @@ class DataParallelLearner(_ParallelLearnerBase):
             hess = jnp.pad(hess, (0, pad))
             row_mask = jnp.pad(row_mask, (0, pad))
 
+        # compacted leaf-wise under the psum schedule subsumes
+        # segmentation (per-split dispatches are short by construction);
+        # the ownership schedule and the segmented path keep the masked
+        # grower
+        use_compact = (not self._depthwise
+                       and self._schedule() == "psum"
+                       and self._leafwise_compact_enabled())
         segments = getattr(self.tree_config, "leafwise_segments", 1)
-        if not self._depthwise and segments > 1:
+        if not self._depthwise and segments > 1 and not use_compact:
             tree = self._segmented_grow(gbdt, bins, grad, hess, row_mask,
                                         feature_mask, mesh, num_shards,
                                         segments)
@@ -514,6 +561,8 @@ class DataParallelLearner(_ParallelLearnerBase):
                         stat_reduce=lambda s: jax.lax.psum(s, DATA_AXIS),
                         hist_axis=DATA_AXIS,
                         **kwargs)
+            elif use_compact:
+                shard_fn = self._compact_grow_fn(kwargs)
             else:
                 # schedule-dispatching leaf-wise closure shared with the
                 # segmented path

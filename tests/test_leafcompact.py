@@ -342,3 +342,100 @@ def test_compact_training_multiclass():
         np.testing.assert_array_equal(t1.threshold_bin, t2.threshold_bin)
         np.testing.assert_allclose(t1.leaf_value, t2.leaf_value,
                                    rtol=1e-4, atol=1e-6)
+
+
+def test_compact_grower_data_parallel_matches_serial():
+    """The compacted grower under the data-parallel psum schedule: each
+    shard keeps its LOCAL rows physically partitioned, per-split
+    histograms are psum'd with a pmax-synced slice tier.  int8 trees
+    must be bit-identical to the serial compacted run (int-domain
+    reduction is order-free); rows not divisible by 8 exercises the
+    shard padding path."""
+    from lightgbm_tpu.config import OverallConfig
+    from lightgbm_tpu.io.dataset import Dataset
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.objectives import create_objective
+    from lightgbm_tpu.parallel import create_parallel_learner
+
+    rng = np.random.RandomState(19)
+    n = 2999                                # 2999 % 8 != 0
+    x = rng.randn(n, 6)
+    y = ((x[:, 0] - 0.5 * x[:, 1] + 0.3 * rng.randn(n)) > 0)
+    ds = Dataset.from_arrays(x, y.astype(np.float32), max_bin=32)
+    params = {"objective": "binary", "num_leaves": "15",
+              "min_data_in_leaf": "20", "min_sum_hessian_in_leaf": "1e-3",
+              "learning_rate": "0.1", "num_iterations": "4",
+              "grow_policy": "leafwise", "hist_dtype": "int8",
+              "leafwise_compact": "true", "dp_schedule": "psum"}
+
+    def run(tree_learner, machines):
+        cfg = OverallConfig()
+        p = dict(params, tree_learner=tree_learner,
+                 num_machines=str(machines))
+        cfg.set(p, require_data=False)
+        b = GBDT()
+        learner = (create_parallel_learner(cfg)
+                   if tree_learner != "serial" else None)
+        b.init(cfg.boosting_config, ds,
+               create_objective(cfg.objective_type, cfg.objective_config),
+               learner=learner)
+        for _ in range(4):
+            b.train_one_iter(is_eval=False)
+        return b
+
+    b_s, b_dp = run("serial", 1), run("data", 8)
+    assert len(b_s.models) == len(b_dp.models) == 4
+    for k, (t1, t2) in enumerate(zip(b_s.models, b_dp.models)):
+        assert t1.num_leaves == t2.num_leaves, f"tree {k}"
+        np.testing.assert_array_equal(t1.split_feature, t2.split_feature,
+                                      err_msg=f"tree {k}")
+        np.testing.assert_array_equal(t1.threshold_bin, t2.threshold_bin,
+                                      err_msg=f"tree {k}")
+        # int accumulators identical; per-program f32 dequantize/search
+        # fusion may differ by a couple ulps (cross-program FMA story)
+        np.testing.assert_allclose(t1.leaf_value, t2.leaf_value,
+                                   rtol=1e-6, atol=1e-9,
+                                   err_msg=f"tree {k}")
+
+
+def test_compact_chunk_path_matches_per_iteration():
+    """Direct train_chunk calls (the CPU-test chunk seam) must ride the
+    SAME compacted grower as the per-iteration path for the same
+    config."""
+    from lightgbm_tpu.config import OverallConfig
+    from lightgbm_tpu.io.dataset import Dataset
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.objectives import create_objective
+
+    rng = np.random.RandomState(21)
+    n = 2000
+    x = rng.randn(n, 5)
+    y = ((x[:, 0] + 0.4 * x[:, 1] + 0.3 * rng.randn(n)) > 0)
+    ds = Dataset.from_arrays(x, y.astype(np.float32), max_bin=32)
+
+    def run(chunked):
+        cfg = OverallConfig()
+        cfg.set({"objective": "binary", "num_leaves": "15",
+                 "min_data_in_leaf": "20",
+                 "min_sum_hessian_in_leaf": "1e-3",
+                 "learning_rate": "0.1", "num_iterations": "4",
+                 "grow_policy": "leafwise", "hist_dtype": "int8",
+                 "leafwise_compact": "true"}, require_data=False)
+        b = GBDT()
+        b.init(cfg.boosting_config, ds,
+               create_objective(cfg.objective_type, cfg.objective_config))
+        if chunked:
+            b.train_chunk(4)
+        else:
+            for _ in range(4):
+                b.train_one_iter(is_eval=False)
+        return b
+
+    b_it, b_ch = run(False), run(True)
+    assert len(b_it.models) == len(b_ch.models) == 4
+    for t1, t2 in zip(b_it.models, b_ch.models):
+        assert t1.num_leaves == t2.num_leaves
+        np.testing.assert_array_equal(t1.split_feature, t2.split_feature)
+        np.testing.assert_array_equal(t1.threshold_bin, t2.threshold_bin)
+        np.testing.assert_allclose(t1.leaf_value, t2.leaf_value,
+                                   rtol=1e-6, atol=1e-9)
