@@ -439,3 +439,46 @@ def test_compact_chunk_path_matches_per_iteration():
         np.testing.assert_array_equal(t1.threshold_bin, t2.threshold_bin)
         np.testing.assert_allclose(t1.leaf_value, t2.leaf_value,
                                    rtol=1e-6, atol=1e-9)
+
+
+def test_compact_training_bagging_feature_fraction():
+    """Bagging + feature_fraction through the compacted grower: the RNG
+    streams and masks are shared machinery, so trajectories must match
+    the masked grower exactly in structure."""
+    from lightgbm_tpu.config import OverallConfig
+    from lightgbm_tpu.io.dataset import Dataset
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.objectives import create_objective
+
+    rng = np.random.RandomState(13)
+    n = 2500
+    x = rng.randn(n, 8)
+    y = ((x[:, 0] - 0.5 * x[:, 1] + 0.3 * rng.randn(n)) > 0)
+    ds = Dataset.from_arrays(x, y.astype(np.float32), max_bin=32)
+
+    def run(compact):
+        cfg = OverallConfig()
+        cfg.set({"objective": "binary", "num_leaves": "15",
+                 "min_data_in_leaf": "20",
+                 "min_sum_hessian_in_leaf": "1e-3",
+                 "learning_rate": "0.1", "num_iterations": "4",
+                 "bagging_fraction": "0.8", "bagging_freq": "2",
+                 "bagging_seed": "7", "feature_fraction": "0.6",
+                 "feature_fraction_seed": "3",
+                 "grow_policy": "leafwise", "hist_dtype": "int8",
+                 "leafwise_compact": compact}, require_data=False)
+        b = GBDT()
+        b.init(cfg.boosting_config, ds,
+               create_objective(cfg.objective_type, cfg.objective_config))
+        for _ in range(4):
+            b.train_one_iter(is_eval=False)
+        return b
+
+    b1, b2 = run("false"), run("true")
+    assert len(b1.models) == len(b2.models) == 4
+    for t1, t2 in zip(b1.models, b2.models):
+        assert t1.num_leaves == t2.num_leaves
+        np.testing.assert_array_equal(t1.split_feature, t2.split_feature)
+        np.testing.assert_array_equal(t1.threshold_bin, t2.threshold_bin)
+        np.testing.assert_allclose(t1.leaf_value, t2.leaf_value,
+                                   rtol=1e-4, atol=1e-6)
