@@ -1343,10 +1343,11 @@ def _get_chunk_program(obj_key, grad_fn, num_class: int, lr: float,
         # by direct train_chunk calls — leaf-wise production training is
         # per-iteration) on the SAME grower as the per-iteration path
         import functools as _ft
+        from ..ops.compact import pallas_partition_ok
         from .grower_leafcompact import grow_tree_leafcompact_impl
         grow = _ft.partial(
             grow_tree_leafcompact_impl,
-            use_pallas_partition=jax.default_backend() == "tpu")
+            use_pallas_partition=pallas_partition_ok())
     else:
         from .grower import grow_tree_impl as grow
     lrf = jnp.float32(lr)
@@ -1424,10 +1425,11 @@ def _serial_learner(gbdt: GBDT, bins, grad, hess, row_mask, feature_mask):
         # compacted growth subsumes leafwise_segments: each split touches
         # only the smaller child's rows, so whole-tree dispatches stay
         # short even at bench scale (grower_leafcompact.py)
+        from ..ops.compact import pallas_partition_ok
         from .grower_leafcompact import grow_tree_leafcompact
         return grow_tree_leafcompact(
             bins, grad, hess, row_mask, feature_mask, gbdt.num_bins_device,
-            use_pallas_partition=jax.default_backend() == "tpu", **kwargs)
+            use_pallas_partition=pallas_partition_ok(), **kwargs)
     segments = getattr(gbdt.tree_config, "leafwise_segments", 1)
     if segments > 1:
         from .grower import grow_tree_segmented

@@ -40,6 +40,18 @@ BLOCK = 2048  # partition lane block: [R<=64, 2048] int8 panes + a [2048,
               # 2048] int8 selection matrix = ~4.3 MB VMEM
 
 
+def pallas_partition_ok() -> bool:
+    """Eligibility of the Pallas partition kernel: TPU default backend,
+    unless LGBM_TPU_NO_PALLAS=1 — the escape hatch a mixed-backend
+    process (TPU backend up, computation steered onto virtual CPU
+    devices, e.g. __graft_entry__.dryrun_multichip) sets so kernels
+    never land on a CPU mesh."""
+    import os
+    if os.environ.get("LGBM_TPU_NO_PALLAS", "") == "1":
+        return False
+    return jax.default_backend() == "tpu"
+
+
 def _partition_kernel(mask_ref, scal_ref, seg_ref, out_ref, win_ref,
                       offs_ref, sem_ref, *, R, block):
     """Grid (nblocks,): both streams (left then right) per lane block.

@@ -39,6 +39,11 @@ def _pallas_hist_ok(num_bins_max: int) -> bool:
     formulation for ALL dtypes (A/B timing escape hatch)."""
     if os.environ.get("LGBM_TPU_HIST_EINSUM", "") == "1":
         return False
+    # LGBM_TPU_NO_PALLAS covers EVERY Pallas kernel (partition + these
+    # histogram kernels, ops/compact.pallas_partition_ok) — the
+    # mixed-backend escape hatch; HIST_EINSUM stays the A/B-timing hatch
+    if os.environ.get("LGBM_TPU_NO_PALLAS", "") == "1":
+        return False
     return jax.default_backend() == "tpu" and num_bins_max <= 256
 
 

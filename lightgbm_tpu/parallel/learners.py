@@ -309,9 +309,10 @@ class DataParallelLearner(_ParallelLearnerBase):
             import functools as _ft
             from ..models.grower_leafcompact import (
                 grow_tree_leafcompact_impl)
+            from ..ops.compact import pallas_partition_ok
             grow = _ft.partial(
                 grow_tree_leafcompact_impl,
-                use_pallas_partition=jax.default_backend() == "tpu")
+                use_pallas_partition=pallas_partition_ok())
         else:
             grow = grow_tree_impl
         lrf = jnp.float32(lr)
@@ -430,6 +431,7 @@ class DataParallelLearner(_ParallelLearnerBase):
         tier is pmax-synced inside the grower so the collectives stay
         uniform across shards."""
         from ..models.grower_leafcompact import grow_tree_leafcompact_impl
+        from ..ops.compact import pallas_partition_ok
 
         def shard_grow(bins_s, grad_s, hess_s, mask_s, fmask, nbins):
             return grow_tree_leafcompact_impl(
@@ -437,7 +439,7 @@ class DataParallelLearner(_ParallelLearnerBase):
                 hist_reduce=lambda h: jax.lax.psum(h, DATA_AXIS),
                 stat_reduce=lambda s: jax.lax.psum(s, DATA_AXIS),
                 hist_axis=DATA_AXIS,
-                use_pallas_partition=jax.default_backend() == "tpu",
+                use_pallas_partition=pallas_partition_ok(),
                 **kwargs)
         return shard_grow
 
