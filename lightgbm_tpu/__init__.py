@@ -36,6 +36,7 @@ try:  # pragma: no cover - environment dependent
 except Exception:
     pass
 
+from . import telemetry
 from .config import OverallConfig, load_config
 from .io.dataset import Dataset
 from .models.gbdt import GBDT
@@ -53,6 +54,13 @@ def train(params: dict, train_set: Dataset, valid_sets=(), valid_names=None):
 
     config = OverallConfig()
     config.set({k: str(v) for k, v in params.items()}, require_data=False)
+    armed_telemetry = bool(config.io_config.metrics_out)
+    if armed_telemetry:
+        telemetry.enable(config.io_config.metrics_out,
+                         fence=config.io_config.metrics_fence)
+        # fresh registry per armed run: a second train() in the same
+        # process must not ship the first run's counters in its records
+        telemetry.reset()
     booster = GBDT()
     objective = create_objective(config.objective_type,
                                  config.objective_config)
@@ -73,5 +81,13 @@ def train(params: dict, train_set: Dataset, valid_sets=(), valid_names=None):
                                for t in config.metric_types) if m is not None]
         booster.add_valid_dataset(valid, metrics, name=name)
     is_eval = bool(train_metrics) or bool(valid_sets)
-    booster.run_training(config.boosting_config.num_iterations, is_eval)
+    try:
+        booster.run_training(config.boosting_config.num_iterations, is_eval)
+    finally:
+        if armed_telemetry:
+            # this call armed the sink, so it closes it: a later train()
+            # without metrics_out must not append records (and a later
+            # fence-free run must not inherit fence mode).  snapshot()
+            # still serves the accumulated data after disable
+            telemetry.disable()
     return booster

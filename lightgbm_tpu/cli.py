@@ -13,6 +13,7 @@ import time
 from typing import List
 
 from . import config as config_mod
+from . import telemetry
 from .config import OverallConfig
 from .io.dataset import Dataset
 from .metrics import create_metric
@@ -30,6 +31,13 @@ class Application:
         if self.config.num_threads > 0:
             from .native import lib as native_lib
             native_lib.set_num_threads(self.config.num_threads)
+        if self.config.io_config.metrics_out:
+            telemetry.enable(self.config.io_config.metrics_out,
+                             fence=self.config.io_config.metrics_fence)
+            telemetry.reset()
+            log.debug("telemetry armed: metrics_out=%s fence=%s"
+                      % (self.config.io_config.metrics_out,
+                         self.config.io_config.metrics_fence))
         self.boosting: GBDT = None
         self.objective = None
         self.train_data = None
@@ -77,7 +85,10 @@ class Application:
 
     def load_data(self, predict_fun=None) -> None:
         """Application::LoadData (application.cpp:119-199)."""
-        start = time.time()
+        # perf_counter, not time.time(): wall clock is not monotonic (NTP
+        # steps would corrupt the duration); message text keeps reference
+        # parity
+        start = time.perf_counter()
         rank = 0
         shard_count = 1
         bin_finder = None
@@ -117,7 +128,8 @@ class Application:
                 if metric is not None:
                     metrics.append(metric)
             self.valid_datas.append((valid, metrics, filename))
-        log.info("Finish loading data, use %f seconds" % (time.time() - start))
+        log.info("Finish loading data, use %f seconds"
+                 % (time.perf_counter() - start))
 
     def train(self) -> None:
         """Application::Train (application.cpp:239-257).
@@ -128,7 +140,7 @@ class Application:
         log.info("Start train ...")
         is_eval = bool(self.train_metrics) or any(
             m for _, m, _ in self.valid_datas)
-        start = time.time()
+        start = time.perf_counter()
 
         def _run():
             self.boosting.run_training(
@@ -137,7 +149,7 @@ class Application:
                     False, self.config.io_config.output_model),
                 progress_fn=lambda it: log.info(
                     "%f seconds elapsed, finished %d iteration"
-                    % (time.time() - start, it)))
+                    % (time.perf_counter() - start, it)))
 
         if self.config.io_config.profile_dir:
             import jax
@@ -177,6 +189,10 @@ def main(argv: List[str] = None) -> int:
         app.run()
     except log.LightGBMError:
         return 1
+    finally:
+        # close the metrics sink armed in Application.__init__ (flushes
+        # pending records; harmless no-op when telemetry was never on)
+        telemetry.disable()
     return 0
 
 

@@ -137,6 +137,15 @@ class IOConfig:
     # TPU extension (SURVEY §5.1): write a jax.profiler trace of the
     # training loop to this directory (view with tensorboard / xprof)
     profile_dir: str = ""
+    # Telemetry (ISSUE 1): per-iteration JSONL metrics sink — one record
+    # per boosting iteration with phase timings, kernel-route counters and
+    # eval metrics (lightgbm_tpu/telemetry.py; pretty-print with
+    # scripts/telemetry_report.py).  metrics_fence=true additionally
+    # block_until_ready-fences phase spans so async dispatch doesn't
+    # attribute device time to the wrong phase (timing-accuracy mode;
+    # slows training, never issues extra dispatches)
+    metrics_out: str = ""
+    metrics_fence: bool = False
     output_result: str = "LightGBM_predict_result.txt"
     input_model: str = ""
     input_init_score: str = ""
@@ -167,6 +176,9 @@ class IOConfig:
             log.fatal("No training/prediction data, application quit")
         self.verbosity = _get_int(params, "verbose", self.verbosity)
         self.profile_dir = _get_str(params, "profile_dir", self.profile_dir)
+        self.metrics_out = _get_str(params, "metrics_out", self.metrics_out)
+        self.metrics_fence = _get_bool(params, "metrics_fence",
+                                       self.metrics_fence)
         self.num_model_predict = _get_int(params, "num_model_predict", self.num_model_predict)
         self.is_pre_partition = _get_bool(params, "is_pre_partition", self.is_pre_partition)
         self.is_enable_sparse = _get_bool(params, "is_enable_sparse", self.is_enable_sparse)
@@ -490,15 +502,9 @@ class OverallConfig:
         self.objective_config.set(params)
         self.metric_config.set(params)
         self._check_param_conflict()
-        # verbosity → log level (config.cpp:59-70)
-        if self.io_config.verbosity == 1:
-            log.set_level(log.INFO)
-        elif self.io_config.verbosity == 0:
-            log.set_level(log.WARNING)
-        elif self.io_config.verbosity >= 2:
-            log.set_level(log.DEBUG)
-        else:
-            log.set_level(log.FATAL)
+        # verbosity → log level (config.cpp:59-70); the mapping lives in
+        # utils/log so the CLI and library entries share one rule
+        log.set_level_from_verbosity(self.io_config.verbosity)
 
     def _check_param_conflict(self) -> None:
         """Reference config.cpp:133-182."""

@@ -21,6 +21,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import telemetry
 from ..utils import log
 from ..ops.scoring import add_tree_score
 from ..ops.lookup import exact_table_lookup as _leaf_lookup
@@ -74,6 +75,10 @@ class GBDT:
         self._mp_fp = False         # multi-process feature-parallel mode
         self._host_inputs = False
         self._row_valid = None
+        # latest metric values keyed "dataset/metric" — rides the
+        # telemetry iteration records (captured only while a sink is
+        # active, _consume_metric_values)
+        self._last_eval_values = {}
 
     # ------------------------------------------------------------------ init
 
@@ -328,12 +333,14 @@ class GBDT:
         self._bag_mask_device = None
 
     def _bagging(self, it: int) -> None:
-        self._draw_bag_mask(it)
-        if self._bag_mask_device is None:
-            if self._mp:
-                self._bag_mask_device = self._mp_make_global(self._bag_mask)
-            else:
-                self._bag_mask_device = jnp.asarray(self._bag_mask)
+        with telemetry.span("bagging"):
+            self._draw_bag_mask(it)
+            if self._bag_mask_device is None:
+                if self._mp:
+                    self._bag_mask_device = self._mp_make_global(
+                        self._bag_mask)
+                else:
+                    self._bag_mask_device = jnp.asarray(self._bag_mask)
 
     def _feature_sample(self, cls: int) -> np.ndarray:
         frac = self.tree_config.feature_fraction
@@ -348,8 +355,10 @@ class GBDT:
     def train_one_iter(self, is_eval: bool = True) -> bool:
         """GBDT::TrainOneIter (gbdt.cpp:167-214).  Returns True when
         training must stop (early stopping or no splittable leaf)."""
-        grad, hess = self.objective.get_gradients(
-            self.score if self.num_class > 1 else self.score[0])
+        with telemetry.span("gradient") as sp:
+            grad, hess = self.objective.get_gradients(
+                self.score if self.num_class > 1 else self.score[0])
+            sp.fence((grad, hess))
         if self.num_class == 1:
             grad = grad[None]
             hess = hess[None]
@@ -370,9 +379,11 @@ class GBDT:
                     np.asarray(feature_mask) if self._mp
                     else jnp.asarray(feature_mask))
 
-            tree_arrays = self._learner(
-                self, self.bins_device, grad[cls], hess[cls], row_mask,
-                self._feat_mask_device[key])
+            with telemetry.span("grow") as sp:
+                tree_arrays = self._learner(
+                    self, self.bins_device, grad[cls], hess[cls], row_mask,
+                    self._feat_mask_device[key])
+                sp.fence(tree_arrays)
 
             # ONE host round-trip for everything the host needs (each
             # device_get pays full tunnel latency; fetching the 8 small
@@ -396,34 +407,41 @@ class GBDT:
             # the reference rejects such trees before any score update
             # (gbdt.cpp:182-185), and this keeps that invariant without
             # waiting for num_leaves on the host
-            shrunk = jnp.where(tree_arrays.num_leaves > 1,
-                               tree_arrays.leaf_value * lr, 0.0)
-            self.score = self.score.at[cls].add(
-                _leaf_lookup(shrunk, tree_arrays.leaf_ids))
+            with telemetry.span("score_update") as sp:
+                shrunk = jnp.where(tree_arrays.num_leaves > 1,
+                                   tree_arrays.leaf_value * lr, 0.0)
+                self.score = self.score.at[cls].add(
+                    _leaf_lookup(shrunk, tree_arrays.leaf_ids))
+                sp.fence(self.score)
             # valid scores via tree replay (gbdt.cpp:220-222); the grower's
             # arrays are already statically padded to num_leaves-1, so the
             # replay jit compiles once and uses no host data
             if self.valid_datasets:
                 max_nodes = len(tree_arrays.split_feature)
-                for entry in self.valid_datasets:
-                    new_cls = add_tree_score(
-                        entry["bins"], entry["score"][cls],
-                        tree_arrays.split_feature,
-                        tree_arrays.threshold_bin,
-                        tree_arrays.left_child,
-                        tree_arrays.right_child,
-                        shrunk,
-                        tree_arrays.num_leaves,
-                        max_nodes=max_nodes)
-                    if self._mp:
-                        # valid state stays host-side numpy in multi-process
-                        # mode (replicated inputs to the global programs)
-                        entry["score"][cls] = np.asarray(new_cls)
-                    else:
-                        entry["score"] = entry["score"].at[cls].set(new_cls)
+                with telemetry.span("valid_update") as sp:
+                    for entry in self.valid_datasets:
+                        new_cls = add_tree_score(
+                            entry["bins"], entry["score"][cls],
+                            tree_arrays.split_feature,
+                            tree_arrays.threshold_bin,
+                            tree_arrays.left_child,
+                            tree_arrays.right_child,
+                            shrunk,
+                            tree_arrays.num_leaves,
+                            max_nodes=max_nodes)
+                        if self._mp:
+                            # valid state stays host-side numpy in
+                            # multi-process mode (replicated inputs to the
+                            # global programs)
+                            entry["score"][cls] = np.asarray(new_cls)
+                        else:
+                            entry["score"] = entry["score"].at[cls].set(
+                                new_cls)
+                        sp.fence(new_cls)
 
             # now block on the (already in-flight) host copy for the model
-            host = jax.device_get(small)
+            with telemetry.span("model_readback"):
+                host = jax.device_get(small)
             num_leaves = int(host.num_leaves)
             if num_leaves <= 1:
                 log.info("Can't training anymore, there isn't any leaf meets "
@@ -436,8 +454,13 @@ class GBDT:
 
         met_early_stopping = False
         if is_eval:
-            met_early_stopping = self.output_metric(self.iter + 1)
+            with telemetry.span("eval"):
+                met_early_stopping = self.output_metric(self.iter + 1)
         self.iter += 1
+        if telemetry.sink_active():
+            dp, dt = telemetry.take_phase_deltas()
+            telemetry.emit_iteration(self.iter, dp, dt,
+                                     eval_metrics=self._last_eval_values)
         if met_early_stopping:
             log.info("Early stopping at iteration %d, the best iteration "
                      "round is %d"
@@ -489,6 +512,17 @@ class GBDT:
                 if stop:
                     break
                 done += chunk_size
+        if self._host_inputs:
+            # fold every host's route counters into the leader before the
+            # summary.  COLLECTIVE, hence outside any telemetry.enabled()
+            # gate: a host whose config lacks metrics_out must still join
+            # the allgather or the enabled hosts would hang in it (every
+            # process reaches this point — run_training's control flow is
+            # host-replicated)
+            from ..parallel.learners import aggregate_telemetry
+            aggregate_telemetry()
+        if telemetry.sink_active():
+            telemetry.emit_summary(extra={"iterations": self.iter})
 
     # ------------------------------------------------------- chunked training
 
@@ -722,13 +756,14 @@ class GBDT:
                 train_in = tuple(s[1] for s in train_specs)
                 valid_in = tuple(tuple(s[1] for s in specs)
                                  for specs in valid_specs)
-            new_score, vscores_out, stacked, mvals = fn(
-                self.score, self.bins_device, self.num_bins_device,
-                own, ownmask, row_masks, feat_masks, obj_in,
-                train_in,
-                tuple(e["bins"] for e in self.valid_datasets),
-                tuple(e["score"] for e in self.valid_datasets),
-                valid_in)
+            with telemetry.span("train_chunk") as sp:
+                new_score, vscores_out, stacked, mvals = sp.fence(fn(
+                    self.score, self.bins_device, self.num_bins_device,
+                    own, ownmask, row_masks, feat_masks, obj_in,
+                    train_in,
+                    tuple(e["bins"] for e in self.valid_datasets),
+                    tuple(e["score"] for e in self.valid_datasets),
+                    valid_in))
             self.score = new_score
         elif dp:
             # pad rows to the shard grid once per booster; padded rows are
@@ -762,24 +797,47 @@ class GBDT:
             _, bins_p, obj_p, valid_rows = cache
             score_in = (jnp.pad(self.score, ((0, 0), (0, pad)))
                         if pad else self.score)
-            new_score, vscores_out, stacked, mvals = fn(
-                score_in, bins_p, self.num_bins_device, valid_rows,
-                row_masks, feat_masks, obj_p,
-                tuple(s[1] for s in train_specs),
-                tuple(e["bins"] for e in self.valid_datasets),
-                tuple(e["score"] for e in self.valid_datasets),
-                tuple(tuple(s[1] for s in specs) for specs in valid_specs))
+            with telemetry.span("train_chunk") as sp:
+                new_score, vscores_out, stacked, mvals = sp.fence(fn(
+                    score_in, bins_p, self.num_bins_device, valid_rows,
+                    row_masks, feat_masks, obj_p,
+                    tuple(s[1] for s in train_specs),
+                    tuple(e["bins"] for e in self.valid_datasets),
+                    tuple(e["score"] for e in self.valid_datasets),
+                    tuple(tuple(s[1] for s in specs)
+                          for specs in valid_specs)))
             self.score = new_score[:, :N] if pad else new_score
         else:
-            self.score, vscores_out, stacked, mvals = fn(
-                self.score, self.bins_device, self.num_bins_device,
-                row_masks, feat_masks, obj_params,
-                tuple(s[1] for s in train_specs),
-                tuple(e["bins"] for e in self.valid_datasets),
-                tuple(e["score"] for e in self.valid_datasets),
-                tuple(tuple(s[1] for s in specs) for specs in valid_specs))
-        host = jax.device_get(stacked)
-        mvals_host = np.asarray(mvals) if eval_each else None
+            with telemetry.span("train_chunk") as sp:
+                self.score, vscores_out, stacked, mvals = sp.fence(fn(
+                    self.score, self.bins_device, self.num_bins_device,
+                    row_masks, feat_masks, obj_params,
+                    tuple(s[1] for s in train_specs),
+                    tuple(e["bins"] for e in self.valid_datasets),
+                    tuple(e["score"] for e in self.valid_datasets),
+                    tuple(tuple(s[1] for s in specs)
+                          for specs in valid_specs)))
+        with telemetry.span("model_readback"):
+            host = jax.device_get(stacked)
+            mvals_host = np.asarray(mvals) if eval_each else None
+
+        # per-iteration telemetry records: the fused program's phases are
+        # indivisible from the host, so its wall time is amortized evenly
+        # across the chunk's iterations (marked "amortized_over")
+        if telemetry.sink_active():
+            _chunk_dp, _chunk_dt = telemetry.take_phase_deltas()
+            _scale = 1.0 / max(k, 1)
+
+            def _emit(i: int) -> None:
+                telemetry.emit_iteration(
+                    self.iter + i + 1,
+                    {p: v * _scale for p, v in _chunk_dp.items()},
+                    {p: v * _scale for p, v in _chunk_dt.items()},
+                    eval_metrics=self._last_eval_values,
+                    extra={"amortized_over": k})
+        else:
+            def _emit(i: int) -> None:
+                pass
 
         keep_iters = k if limit < 0 else min(k, limit)
         esr = self.early_stopping_round
@@ -805,6 +863,7 @@ class GBDT:
                 if self._consume_metric_values(self.iter + i + 1,
                                                train_vals, valid_vals):
                     kept = i + 1
+                    _emit(i)
                     log.info("Early stopping at iteration %d, the best "
                              "iteration round is %d"
                              % (self.iter + kept, self.iter + kept - esr))
@@ -823,6 +882,7 @@ class GBDT:
                     del self.models[len(self.models) - esr * C:]
                     self.iter += kept
                     return True
+            _emit(i)
         if keep_iters < k:
             self._rollback_chunk(keep_iters * C, keep_iters * C,
                                  bag_state, ff_states, score_before,
@@ -979,6 +1039,18 @@ class GBDT:
         freq = self.gbdt_config.output_freq
         eval_now = freq > 0 and iteration % freq == 0
         ret = False
+        if telemetry.sink_active():
+            vals = {}
+            if train_vals is not None:
+                for metric, values in zip(self.training_metrics, train_vals):
+                    vals["training/" + metric.name] = list(values)
+            if valid_vals is not None:
+                for i, entry in enumerate(self.valid_datasets):
+                    for j, metric in enumerate(self.valid_metrics[i]):
+                        vals[entry["name"] + "/" + metric.name] = list(
+                            valid_vals[i][j])
+            if vals:
+                self._last_eval_values = vals
         if eval_now and train_vals is not None:
             for metric, values in zip(self.training_metrics, train_vals):
                 log.info("Iteration:%d, %s : %s"

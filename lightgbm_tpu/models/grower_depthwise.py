@@ -38,6 +38,7 @@ import math
 import jax
 import jax.numpy as jnp
 
+from .. import telemetry
 from ..ops.histogram import histogram_leafbatch
 from ..ops.split import find_best_split
 from .grower import TreeArrays
@@ -187,9 +188,13 @@ def grow_tree_depthwise(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     for d in range(D):
         P = 1 << d
 
-        # ---- best split per slot (vmapped FindBestThreshold scan)
-        res = vsplit(hists, slot_g, slot_h, slot_c, num_bins, feature_mask,
-                     mind, minh)
+        # ---- best split per slot (vmapped FindBestThreshold scan).  The
+        # span wraps the CALL (not the vmapped body — a batching trace is
+        # never "execution"), so eager runs (jax.disable_jit telemetry
+        # profiling) attribute real split-search time
+        with telemetry.span("split_find") as _sp:
+            res = _sp.fence(vsplit(hists, slot_g, slot_h, slot_c, num_bins,
+                                   feature_mask, mind, minh))
         can = alive & (res.gain > 0.0) & jnp.isfinite(res.gain)
 
         # ---- budget: split the top-gain slots first (within-level
@@ -246,49 +251,55 @@ def grow_tree_depthwise(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         # so it is generated once and contracted against a packed [P, K]
         # table.
         small_is_right = res.right_count < res.left_count        # ties → left
-        table = jnp.stack([res.feature.astype(f32),
-                           res.threshold.astype(f32),
-                           chosen.astype(f32),
-                           right_leaf.astype(f32),
-                           small_is_right.astype(f32)], axis=1)  # [P, 5]
-        lsel = (slot_id[None, :] ==
-                jnp.arange(P, dtype=i32)[:, None]).astype(f32)   # [P, N]
-        # The table carries integer ids (feature, threshold, leaf).  Default
-        # TPU matmul precision truncates f32 operands to bf16, which is
-        # EXACT for integers <= 256 — and exactly one lsel entry matches
-        # per row, so there is no accumulation error either.  Only configs
-        # with ids beyond 256 need the 6-pass HIGHEST decomposition
-        # (measured 2.27 ms vs 0.72 ms per level at 11M rows).
-        ids_bf16_exact = max(F, B, L) <= 256
-        attr_prec = (None if ids_bf16_exact
-                     else jax.lax.Precision.HIGHEST)
-        attrs = jnp.einsum("pn,pk->kn", lsel, table,
-                           precision=attr_prec,
-                           preferred_element_type=jnp.float32)   # [5, N]
-        feat_row = attrs[0].astype(i32)
-        thr_row = attrs[1].astype(i32)
-        in_chosen = attrs[2] > 0.5
-        rl_row = attrs[3].astype(i32)
-        small_right_row = attrs[4] > 0.5
+        with telemetry.span("partition") as _sp:
+            table = jnp.stack([res.feature.astype(f32),
+                               res.threshold.astype(f32),
+                               chosen.astype(f32),
+                               right_leaf.astype(f32),
+                               small_is_right.astype(f32)], axis=1)  # [P, 5]
+            lsel = (slot_id[None, :] ==
+                    jnp.arange(P, dtype=i32)[:, None]).astype(f32)   # [P, N]
+            # The table carries integer ids (feature, threshold, leaf).
+            # Default TPU matmul precision truncates f32 operands to bf16,
+            # which is EXACT for integers <= 256 — and exactly one lsel
+            # entry matches per row, so there is no accumulation error
+            # either.  Only configs with ids beyond 256 need the 6-pass
+            # HIGHEST decomposition (measured 2.27 ms vs 0.72 ms per level
+            # at 11M rows).
+            ids_bf16_exact = max(F, B, L) <= 256
+            attr_prec = (None if ids_bf16_exact
+                         else jax.lax.Precision.HIGHEST)
+            attrs = jnp.einsum("pn,pk->kn", lsel, table,
+                               precision=attr_prec,
+                               preferred_element_type=jnp.float32)   # [5, N]
+            feat_row = attrs[0].astype(i32)
+            thr_row = attrs[1].astype(i32)
+            in_chosen = attrs[2] > 0.5
+            rl_row = attrs[3].astype(i32)
+            small_right_row = attrs[4] > 0.5
 
-        # the row's bin on its slot's split feature: an O(F·N) feature
-        # one-hot avoids materializing the old [P, N] row gather, but its
-        # cost grows with the dataset width — for wide datasets a direct
-        # per-row gather is cheaper than F·N comparisons
-        Fg = partition_bins.shape[0]
-        if Fg <= 128:
-            fsel = (feat_row[None, :] == jnp.arange(Fg, dtype=i32)[:, None])
-            # bins < 256 are bf16-exact and one fsel entry matches per row
-            row_bin = jnp.einsum(
-                "fn,fn->n", fsel.astype(f32), partition_bins.astype(f32),
-                precision=(None if B <= 256
-                           else jax.lax.Precision.HIGHEST)).astype(i32)
-        else:
-            row_bin = jnp.take_along_axis(
-                partition_bins, feat_row[None, :], axis=0)[0].astype(i32)
-        go_right = row_bin > thr_row
-        out_leaf = jnp.where(in_chosen & go_right, rl_row, out_leaf)
-        slot_id = 2 * slot_id + jnp.where(in_chosen, go_right.astype(i32), 0)
+            # the row's bin on its slot's split feature: an O(F·N) feature
+            # one-hot avoids materializing the old [P, N] row gather, but
+            # its cost grows with the dataset width — for wide datasets a
+            # direct per-row gather is cheaper than F·N comparisons
+            Fg = partition_bins.shape[0]
+            if Fg <= 128:
+                fsel = (feat_row[None, :]
+                        == jnp.arange(Fg, dtype=i32)[:, None])
+                # bins < 256 are bf16-exact and one fsel entry matches per
+                # row
+                row_bin = jnp.einsum(
+                    "fn,fn->n", fsel.astype(f32), partition_bins.astype(f32),
+                    precision=(None if B <= 256
+                               else jax.lax.Precision.HIGHEST)).astype(i32)
+            else:
+                row_bin = jnp.take_along_axis(
+                    partition_bins, feat_row[None, :], axis=0)[0].astype(i32)
+            go_right = row_bin > thr_row
+            out_leaf = jnp.where(in_chosen & go_right, rl_row, out_leaf)
+            slot_id = (2 * slot_id
+                       + jnp.where(in_chosen, go_right.astype(i32), 0))
+            _sp.fence((out_leaf, slot_id))
 
         if d + 1 >= D:
             break

@@ -45,11 +45,22 @@ def pallas_partition_ok() -> bool:
     unless LGBM_TPU_NO_PALLAS=1 — the escape hatch a mixed-backend
     process (TPU backend up, computation steered onto virtual CPU
     devices, e.g. __graft_entry__.dryrun_multichip) sets so kernels
-    never land on a CPU mesh."""
+    never land on a CPU mesh.  Every outcome is counted (telemetry) —
+    the runtime record of which partition route the process baked into
+    its programs."""
     import os
+    from .. import telemetry
     if os.environ.get("LGBM_TPU_NO_PALLAS", "") == "1":
+        # count_route: this rule is re-evaluated per tree by host code, so
+        # counting per outcome CHANGE keeps the counter at per-decision
+        # magnitude like the trace-time counters
+        telemetry.count_route("partition_ok", "partition/env_no_pallas")
         return False
-    return jax.default_backend() == "tpu"
+    ok = jax.default_backend() == "tpu"
+    telemetry.count_route("partition_ok",
+                          "partition/pallas_eligible" if ok
+                          else "partition/pallas_ineligible")
+    return ok
 
 
 def _partition_kernel(mask_ref, scal_ref, seg_ref, out_ref, win_ref,
@@ -136,6 +147,16 @@ def partition_segment(seg, mask3, delta, cnt, plcnt, *, block: int = BLOCK,
     in original relative order, [delta+plcnt, delta+cnt) the right rows,
     everything else byte-identical to the input.
     """
+    from .. import telemetry
+    telemetry.count("partition/pallas" if use_pallas else "partition/xla")
+    with telemetry.span("partition") as sp:
+        return sp.fence(_partition_segment_impl(
+            seg, mask3, delta, cnt, plcnt, block=block,
+            use_pallas=use_pallas, interpret=interpret))
+
+
+def _partition_segment_impl(seg, mask3, delta, cnt, plcnt, *, block,
+                            use_pallas, interpret):
     R, W = seg.shape
     assert W % block == 0, (W, block)
     lane = jnp.arange(W, dtype=jnp.int32)

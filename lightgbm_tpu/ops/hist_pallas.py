@@ -112,6 +112,8 @@ def hist_pallas_raw(bins, packed, *, B: int, chunk: int = 2048,
     rows in turn, so F is unbounded (the row side-band is re-read per
     block — F/Fb x a few MB of HBM, noise next to the matmuls).
     """
+    from .. import telemetry
+    telemetry.count("hist/pallas_kernel_" + dtype)
     F, N = bins.shape
     assert N % chunk == 0 and packed.shape == (stats + 1, N)
     compute_dtype = jnp.int8 if dtype == "int8" else jnp.bfloat16
@@ -281,11 +283,13 @@ def hist_pallas_leafbatch(bins, grad, hess, col_id, col_ok, num_cols: int,
     64 columns run as ONE pass (<=42 columns fill one 128-lane MXU tile;
     43-64 use a 192-lane operand = 1.5 tiles, cheaper than two full
     passes over the data); wider levels split into 64-column groups."""
-    return _grouped(_hist_pallas_one, bins, grad, hess, col_id, col_ok,
-                    num_cols, num_bins_max, group_width=64, chunk=chunk,
-                    dtype=dtype, rng_bits=rng_bits, axis_name=axis_name,
-                    int_reduce=int_reduce, stochastic=stochastic,
-                    salt=salt)
+    from .. import telemetry
+    with telemetry.span("histogram") as sp:
+        return sp.fence(_grouped(
+            _hist_pallas_one, bins, grad, hess, col_id, col_ok,
+            num_cols, num_bins_max, group_width=64, chunk=chunk,
+            dtype=dtype, rng_bits=rng_bits, axis_name=axis_name,
+            int_reduce=int_reduce, stochastic=stochastic, salt=salt))
 
 
 def _hist_pallas_one(bins, grad, hess, col_id, col_ok, num_cols, B, *,
@@ -415,10 +419,14 @@ def hist_quant_xla(bins, grad, hess, col_id, col_ok, num_cols: int,
     """XLA reference of the SAME quantized-gradient math as the Pallas int8
     kernel (bit-identical output) — the CPU-testable oracle and the
     fallback on non-TPU backends."""
-    return _grouped(_hist_quant_xla_one, bins, grad, hess, col_id, col_ok,
-                    num_cols, num_bins_max, chunk=chunk, rng_bits=rng_bits,
-                    axis_name=axis_name, int_reduce=int_reduce,
-                    stochastic=stochastic, salt=salt)
+    from .. import telemetry
+    telemetry.count("hist/xla_int_kernel")
+    with telemetry.span("histogram") as sp:
+        return sp.fence(_grouped(
+            _hist_quant_xla_one, bins, grad, hess, col_id, col_ok,
+            num_cols, num_bins_max, chunk=chunk, rng_bits=rng_bits,
+            axis_name=axis_name, int_reduce=int_reduce,
+            stochastic=stochastic, salt=salt))
 
 
 def _hist_quant_xla_one(bins, grad, hess, col_id, col_ok, num_cols, B, *,

@@ -24,6 +24,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from .. import telemetry
+
 # transient one-hot working-set budget (bytes) for the chunked matmul
 CHUNK_BYTE_BUDGET = 256 << 20
 # virtual (pre-tiling) one-hot budget for the leaf-batched kernel
@@ -36,15 +38,23 @@ def _pallas_hist_ok(num_bins_max: int) -> bool:
     carry int16 bins the kernel cannot ride).  Dataset WIDTH is unbounded:
     the kernel grids over VMEM-sized feature blocks
     (hist_pallas.feature_block).  LGBM_TPU_HIST_EINSUM=1 forces the XLA
-    formulation for ALL dtypes (A/B timing escape hatch)."""
+    formulation for ALL dtypes (A/B timing escape hatch).
+
+    Every outcome is counted (telemetry): routing decisions are trace-time
+    events baked into the compiled program, so these counters are the
+    runtime record of which kernels the process's programs actually use."""
     if os.environ.get("LGBM_TPU_HIST_EINSUM", "") == "1":
+        telemetry.count("hist/env_force_einsum")
         return False
     # LGBM_TPU_NO_PALLAS covers EVERY Pallas kernel (partition + these
     # histogram kernels, ops/compact.pallas_partition_ok) — the
     # mixed-backend escape hatch; HIST_EINSUM stays the A/B-timing hatch
     if os.environ.get("LGBM_TPU_NO_PALLAS", "") == "1":
+        telemetry.count("hist/env_no_pallas")
         return False
-    return jax.default_backend() == "tpu" and num_bins_max <= 256
+    ok = jax.default_backend() == "tpu" and num_bins_max <= 256
+    telemetry.count("hist/pallas_eligible" if ok else "hist/pallas_ineligible")
+    return ok
 
 
 def histogram_matmul(bins: jax.Array, grad: jax.Array, hess: jax.Array,
@@ -65,6 +75,14 @@ def histogram_matmul(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     hist : [F, B, 3] float32 — (sum_grad, sum_hess, count) per bin, matching
     HistogramBinEntry (bin.h:20-42).
     """
+    telemetry.count("hist/xla_matmul")
+    with telemetry.span("histogram") as sp:
+        return sp.fence(_histogram_matmul_impl(
+            bins, grad, hess, mask, num_bins_max, chunk, compute_dtype))
+
+
+def _histogram_matmul_impl(bins, grad, hess, mask, num_bins_max, chunk,
+                           compute_dtype) -> jax.Array:
     F, N = bins.shape
     B = num_bins_max
     # bound the transient one-hot working set ([F, chunk, B] floats) by a
@@ -156,15 +174,19 @@ def histogram_leafbatch(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         stochastic = compute_dtype == "int8_sr"
         from .hist_pallas import hist_pallas_leafbatch, hist_quant_xla
         if _pallas_hist_ok(num_bins_max):
-            return hist_pallas_leafbatch(bins, grad, hess, col_id, col_ok,
-                                         num_cols, num_bins_max,
-                                         axis_name=axis_name,
-                                         int_reduce=int_reduce,
-                                         stochastic=stochastic, salt=salt)
-        return hist_quant_xla(bins, grad, hess, col_id, col_ok, num_cols,
-                              num_bins_max, chunk=chunk,
-                              axis_name=axis_name, int_reduce=int_reduce,
-                              stochastic=stochastic, salt=salt)
+            telemetry.count("hist/pallas_int8")
+            with telemetry.span("histogram") as sp:
+                return sp.fence(hist_pallas_leafbatch(
+                    bins, grad, hess, col_id, col_ok, num_cols,
+                    num_bins_max, axis_name=axis_name,
+                    int_reduce=int_reduce, stochastic=stochastic,
+                    salt=salt))
+        telemetry.count("hist/xla_int8")
+        with telemetry.span("histogram") as sp:
+            return sp.fence(hist_quant_xla(
+                bins, grad, hess, col_id, col_ok, num_cols, num_bins_max,
+                chunk=chunk, axis_name=axis_name, int_reduce=int_reduce,
+                stochastic=stochastic, salt=salt))
     # float dtypes on TPU: hand-scheduled Pallas kernel with bf16 operands
     # (f32 rides a hi/lo operand split — one 5-stat pass for narrow
     # levels, two 3-stat passes wider).  This routes AROUND the XLA
@@ -178,9 +200,23 @@ def histogram_leafbatch(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     if _pallas_hist_ok(num_bins_max):
         from .hist_pallas import hist_pallas_float_leafbatch
         precision = ("bf16" if compute_dtype == jnp.bfloat16 else "f32")
-        return hist_pallas_float_leafbatch(bins, grad, hess, col_id,
-                                           col_ok, num_cols, num_bins_max,
-                                           precision=precision)
+        telemetry.count("hist/pallas_" + precision)
+        with telemetry.span("histogram") as sp:
+            return sp.fence(hist_pallas_float_leafbatch(
+                bins, grad, hess, col_id, col_ok, num_cols, num_bins_max,
+                precision=precision))
+    telemetry.count("hist/xla_einsum")
+    with telemetry.span("histogram") as sp:
+        return sp.fence(_leafbatch_einsum(
+            bins, grad, hess, col_id, col_ok, num_cols, num_bins_max,
+            chunk=chunk, compute_dtype=compute_dtype))
+
+
+def _leafbatch_einsum(bins, grad, hess, col_id, col_ok, num_cols: int,
+                      num_bins_max: int, chunk: int = 65536,
+                      compute_dtype=jnp.bfloat16) -> jax.Array:
+    """The XLA one-hot-einsum leaf-batched formulation (CPU / testing
+    oracle and the forced-fallback route)."""
     F, N = bins.shape
     B = num_bins_max
     # cap the pass at ONE 128-lane tile of the value operand (42 histogram
@@ -195,7 +231,7 @@ def histogram_leafbatch(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         for base in range(0, num_cols, width):
             k = min(width, num_cols - base)
             ok = col_ok & (col_id >= base) & (col_id < base + k)
-            parts.append(histogram_leafbatch(
+            parts.append(_leafbatch_einsum(
                 bins, grad, hess, col_id - base, ok, k, num_bins_max,
                 chunk=chunk, compute_dtype=compute_dtype))
         return jnp.concatenate(parts, axis=0)

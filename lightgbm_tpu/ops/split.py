@@ -27,6 +27,8 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from .. import telemetry
+
 K_EPSILON = 1e-15  # meta.h kEpsilon
 NEG_INF = -jnp.inf
 
@@ -60,6 +62,15 @@ def find_best_split(hist: jax.Array, sum_grad: jax.Array, sum_hess: jax.Array,
     num_bins : [F] int32 — real bin count per feature (B is padded)
     feature_mask : [F] bool — feature_fraction sampling / ownership masks
     """
+    with telemetry.span("split_find") as sp:
+        return sp.fence(_find_best_split_impl(
+            hist, sum_grad, sum_hess, num_data, num_bins, feature_mask,
+            min_data_in_leaf, min_sum_hessian_in_leaf))
+
+
+def _find_best_split_impl(hist, sum_grad, sum_hess, num_data, num_bins,
+                          feature_mask, min_data_in_leaf,
+                          min_sum_hessian_in_leaf) -> SplitResult:
     F, B, _ = hist.shape
     eps = jnp.float32(K_EPSILON)
 

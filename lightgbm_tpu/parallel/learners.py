@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from .. import telemetry
 from ..models.grower import TreeArrays, _GrowState, grow_tree_impl
 from ..models.grower_depthwise import grow_tree_depthwise
 from ..models.gbdt import _effective_num_leaves, _tuning_kwargs
@@ -35,6 +36,50 @@ from ..ops.split import SplitResult, find_best_split
 from ..io.binning import BinMapper
 from ..utils import log
 from .mesh import DATA_AXIS, FEATURE_AXIS, get_mesh
+
+
+def aggregate_telemetry() -> None:
+    """Fold every host's kernel-route counters into the leader's registry
+    (``allhosts/<name>`` keys) so the leader's JSONL summary speaks for
+    the whole job, not just process 0.
+
+    COLLECTIVE: every multi-process run must call it on EVERY process
+    (gbdt.run_training does, at end of training) — including processes
+    with telemetry disabled, whose counters are simply empty; gating
+    participation on local telemetry state would hang the enabled hosts
+    in the allgather.  Hosts may also disagree on which counters exist (a
+    per-process LGBM_TPU_NO_PALLAS trip, a warm persistent compile cache
+    skipping recompiles), so each host ships its counters as a JSON blob
+    in a fixed-size byte buffer and the sum is aligned BY NAME — a
+    fixed-order value allgather would silently add other hosts' values to
+    the wrong keys whenever key sets differ with equal cardinality.
+    Single-process runs return immediately."""
+    if jax.process_count() <= 1:
+        return
+    blob_cap = 1 << 14
+    try:
+        import json
+        from jax.experimental import multihost_utils
+        items = sorted(telemetry.counters().items())
+        raw = json.dumps(dict(items)).encode()
+        while len(raw) > blob_cap and items:  # pragma: no cover - 100s of keys
+            items = items[:len(items) // 2]
+            raw = json.dumps(dict(items)).encode()
+            log.warning("telemetry counters exceed the %d-byte aggregation "
+                        "buffer; cross-host sums cover only this host's "
+                        "first %d keys" % (blob_cap, len(items)))
+        buf = np.zeros(blob_cap, np.uint8)
+        buf[:len(raw)] = np.frombuffer(raw, np.uint8)
+        gathered = np.asarray(multihost_utils.process_allgather(buf))
+        totals: dict = {}
+        for row in gathered:
+            payload = bytes(row).rstrip(b"\x00").decode()
+            for k, v in json.loads(payload or "{}").items():
+                totals[k] = totals.get(k, 0) + int(v)
+        if telemetry.enabled():
+            telemetry.merge_host_counters(totals)
+    except Exception as e:  # pragma: no cover - collective failure
+        log.warning("telemetry cross-host aggregation failed: %s" % e)
 
 try:
     from jax import shard_map as _shard_map  # JAX >= 0.7 name
@@ -546,12 +591,17 @@ class DataParallelLearner(_ParallelLearnerBase):
                        and self._leafwise_compact_enabled())
         segments = getattr(self.tree_config, "leafwise_segments", 1)
         if not self._depthwise and segments > 1 and not use_compact:
+            telemetry.count_route("learner_dp", "learner/dp_segmented")
             tree = self._segmented_grow(gbdt, bins, grad, hess, row_mask,
                                         feature_mask, mesh, num_shards,
                                         segments)
             if pad:
                 tree = tree._replace(leaf_ids=tree.leaf_ids[:N])
             return tree
+        telemetry.count_route(
+            "learner_dp", "learner/dp_" + ("depthwise" if self._depthwise
+                                           else "compact" if use_compact
+                                           else "leafwise"))
 
         if self._jitted is None:
             kwargs = self._grow_kwargs(gbdt)
@@ -726,6 +776,9 @@ class FeatureParallelLearner(_ParallelLearnerBase):
         mesh = get_mesh(self.config.network_config.num_machines, FEATURE_AXIS,
                         getattr(self.config, 'device_type', ''))
         num_shards = mesh.shape[FEATURE_AXIS]
+        telemetry.count_route(
+            "learner_fp", "learner/fp_" + ("depthwise" if self._depthwise
+                                           else "leafwise"))
 
         if self._jitted is None:
             kwargs = self._grow_kwargs(gbdt)

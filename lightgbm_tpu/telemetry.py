@@ -1,0 +1,411 @@
+"""Process-wide telemetry: phase timers, kernel-route counters, JSONL sink.
+
+The repo's previous observability was three ad-hoc hacks: ``time.time()``
+prints in cli.py, hist-stubbed A/B differencing in scripts/profile_phases.py
+(PROFILE.md), and hand-assembled counter tables in BENCH rounds.  This module
+replaces them with one registry, designed around two JAX realities:
+
+1. **Route decisions are trace-time events.**  Kernel routing (Pallas int8 /
+   bf16 / f32 hit, XLA einsum fallback, ``LGBM_TPU_NO_PALLAS`` trips,
+   partition-kernel eligibility — ops/histogram.py, ops/compact.py) happens
+   while a program is being *traced*; the compiled program then replays the
+   chosen route forever.  Counters therefore increment once per traced
+   decision — exactly the record of "which route did this program actually
+   bake in" that the mixed-backend hardening episodes (commit e7ff0d9)
+   lacked.  Recompiles are counted via a ``jax.monitoring`` backend-compile
+   listener (cache hits fire nothing, so the count is true recompiles).
+
+2. **Spans are host-side wall timers.**  ``span("histogram")`` times the
+   enclosed *host* call with ``time.perf_counter``.  A span entered while
+   JAX is tracing is recorded under ``trace_times`` (it measured tracing,
+   not execution); a span entered with concrete arrays (the boosting loop's
+   host phases, or any op under ``jax.disable_jit()``) is recorded under
+   ``phase_times``.  The optional **fence mode** (``set_fence(True)`` /
+   ``enable(fence=True)``) calls ``jax.block_until_ready`` on a value the
+   caller hands to ``Span.fence(x)`` before stopping the timer, so async
+   dispatch does not attribute device time to the wrong phase.  Fencing
+   only *waits* on already-dispatched work — it never issues device
+   computation — so it cannot trip the environment's ~60 s per-dispatch
+   execution watchdog (BASELINE.md).
+
+Zero overhead when disabled: every public entry checks one module flag and
+returns a no-op singleton; nothing is ever inserted into traced programs,
+so enabling/disabling telemetry perturbs neither numerics nor jit caching
+(tests/test_telemetry.py locks this in).
+
+JSONL sink: ``enable(jsonl_path)`` (the ``metrics_out=...`` config/CLI
+option) arms a per-iteration record stream; the boosting loop emits one
+line per iteration::
+
+    {"iter": 3, "phase_times": {...}, "trace_times": {...},
+     "counters": {...}, "eval_metrics": {...}}
+
+``phase_times`` are seconds spent per phase *in that iteration* (chunked
+training amortizes the fused k-iteration program evenly across its kept
+iterations and marks ``"amortized_over": k``); ``counters`` are cumulative.
+The canonical phase keys ``histogram``, ``split_find``, ``partition``,
+``eval`` are always present.  In multi-process runs only process 0 opens
+the sink (decided lazily at first write, after jax.distributed init);
+``parallel.learners.aggregate_telemetry`` folds every host's counters into
+the leader before the final summary record.  Library users who want the
+data without a file call ``snapshot()``.
+"""
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict, List, Optional
+
+# Canonical per-iteration phase keys — always present in iteration records
+# (ISSUE 1 acceptance schema), whether or not the phase ran this iteration.
+CANONICAL_PHASES = ("histogram", "split_find", "partition", "eval")
+
+_enabled = False
+_fence = False
+_sink_path: Optional[str] = None
+_sink_file = None
+_sink_error = False
+
+_counters: Dict[str, int] = {}
+_phase_times: Dict[str, float] = {}
+_phase_counts: Dict[str, int] = {}
+_trace_times: Dict[str, float] = {}
+# span re-entrancy stack (host-side, single-threaded boosting loop): a span
+# whose name is already active is suppressed so recursive helpers
+# (histogram_leafbatch's width-grouped self-calls, build_histogram →
+# leafbatch) don't double-count wall time under one name
+_span_stack: List[str] = []
+# marks for per-iteration deltas
+_mark_phase: Dict[str, float] = {}
+_mark_trace: Dict[str, float] = {}
+# last outcome per host-evaluated routing rule (count_route dedup)
+_route_state: Dict[str, str] = {}
+
+_compile_listener_installed = False
+
+
+# --------------------------------------------------------------- life cycle
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable(jsonl_path: Optional[str] = None, fence: bool = False) -> None:
+    """Arm the registry (and optionally a JSONL sink at ``jsonl_path``).
+
+    Idempotent; a second call can attach a sink or toggle fence mode.  The
+    sink file is opened lazily at first record — after jax.distributed
+    initialization — so only process 0 writes in multi-process runs.
+    """
+    global _enabled, _fence, _sink_path, _sink_error, _sink_file
+    _enabled = True
+    _fence = bool(fence)
+    if jsonl_path:
+        if _sink_file is not None and jsonl_path != _sink_path:
+            # re-targeting an open sink: close the old handle or records
+            # would keep landing in the previous file
+            try:
+                _sink_file.close()
+            except OSError:
+                pass
+            _sink_file = None
+        _sink_path = jsonl_path
+        _sink_error = False
+    _install_compile_listener()
+
+
+def disable() -> None:
+    """Stop recording and close the sink (pending data is flushed)."""
+    global _enabled, _fence, _sink_file, _sink_path
+    _enabled = False
+    _fence = False
+    if _sink_file is not None:
+        try:
+            _sink_file.close()
+        except OSError:
+            pass
+    _sink_file = None
+    _sink_path = None
+
+
+def reset() -> None:
+    """Zero all counters/timers (sink and enabled state are untouched)."""
+    _counters.clear()
+    _phase_times.clear()
+    _phase_counts.clear()
+    _trace_times.clear()
+    _mark_phase.clear()
+    _mark_trace.clear()
+    _route_state.clear()
+    del _span_stack[:]
+
+
+def set_fence(on: bool) -> None:
+    global _fence
+    _fence = bool(on)
+
+
+def fence_enabled() -> bool:
+    return _fence
+
+
+def sink_active() -> bool:
+    """True when iteration records have somewhere to go (a sink path is
+    configured) — the boosting loop's cheap guard around record assembly."""
+    return _enabled and _sink_path is not None
+
+
+# ------------------------------------------------------------------- spans
+
+class _NullSpan:
+    """No-op span returned while telemetry is disabled (or re-entrant)."""
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def fence(self, value):
+        return value
+
+
+_NULL_SPAN = _NullSpan()
+
+
+def _tracing() -> bool:
+    try:
+        import jax.core
+        return not jax.core.trace_state_clean()
+    except Exception:
+        return False
+
+
+class Span:
+    """Context-managed phase timer.  ``fence(x)`` hands the span a value to
+    ``jax.block_until_ready`` at exit when fence mode is on (execution-time
+    spans only; trace-time spans never block)."""
+    __slots__ = ("name", "_t0", "_fence_val", "_is_trace")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._fence_val = None
+        self._is_trace = False
+        self._t0 = 0.0
+
+    def __enter__(self):
+        self._is_trace = _tracing()
+        _span_stack.append(self.name)
+        self._t0 = time.perf_counter()
+        return self
+
+    def fence(self, value):
+        self._fence_val = value
+        return value
+
+    def __exit__(self, exc_type, exc, tb):
+        if (_fence and not self._is_trace and exc_type is None
+                and self._fence_val is not None):
+            try:
+                import jax
+                jax.block_until_ready(self._fence_val)
+            except Exception:
+                pass
+        dt = time.perf_counter() - self._t0
+        self._fence_val = None
+        if _span_stack and _span_stack[-1] == self.name:
+            _span_stack.pop()
+        if self._is_trace:
+            _trace_times[self.name] = _trace_times.get(self.name, 0.0) + dt
+        else:
+            _phase_times[self.name] = _phase_times.get(self.name, 0.0) + dt
+            _phase_counts[self.name] = _phase_counts.get(self.name, 0) + 1
+        return False
+
+
+def span(name: str):
+    """Phase timer: ``with telemetry.span("histogram") as sp: ...``.
+
+    Returns a shared no-op when telemetry is disabled or a span of the same
+    name is already open (re-entrant helper calls)."""
+    if not _enabled or name in _span_stack:
+        return _NULL_SPAN
+    return Span(name)
+
+
+# ----------------------------------------------------------------- counters
+
+def count(name: str, n: int = 1) -> None:
+    """Bump a monotonic counter (kernel-route decisions, env-var trips,
+    recompiles).  No-op while disabled."""
+    if _enabled:
+        _counters[name] = _counters.get(name, 0) + n
+
+
+def count_route(group: str, name: str) -> None:
+    """Record a routing-decision OUTCOME for a rule that host code
+    re-evaluates every call (e.g. ops/compact.pallas_partition_ok, once
+    per tree): counts once per outcome change within ``group``, so the
+    counter reads as decisions, not evaluations — matching the trace-time
+    counters' per-decision magnitude."""
+    if not _enabled:
+        return
+    if _route_state.get(group) != name:
+        _route_state[group] = name
+        count(name)
+
+
+def counters() -> Dict[str, int]:
+    return dict(_counters)
+
+
+def merge_host_counters(totals: Dict[str, int]) -> None:
+    """Install cross-host counter sums (parallel.learners.
+    aggregate_telemetry) under ``allhosts/`` keys on this process."""
+    for k, v in totals.items():
+        _counters["allhosts/" + k] = int(v)
+
+
+def _install_compile_listener() -> None:
+    """Count true recompiles via jax.monitoring: the backend-compile
+    duration event fires once per compilation-cache miss and never on a
+    hit, so the counter is exactly the number of XLA compiles this process
+    paid.  Registered once; increments are gated on the enabled flag
+    (jax.monitoring has no unregister)."""
+    global _compile_listener_installed
+    if _compile_listener_installed:
+        return
+    try:
+        from jax import monitoring
+
+        def _on_duration(name: str, dur: float, **kw) -> None:
+            if _enabled and name.endswith("backend_compile_duration"):
+                _counters["jit/backend_compile"] = (
+                    _counters.get("jit/backend_compile", 0) + 1)
+                _trace_times["backend_compile"] = (
+                    _trace_times.get("backend_compile", 0.0) + dur)
+
+        monitoring.register_event_duration_secs_listener(_on_duration)
+        _compile_listener_installed = True
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------- snapshots
+
+def snapshot() -> dict:
+    """Cumulative registry state for library users (no sink required)."""
+    return {
+        "phase_times": dict(_phase_times),
+        "phase_counts": dict(_phase_counts),
+        "trace_times": dict(_trace_times),
+        "counters": dict(_counters),
+    }
+
+
+def take_phase_deltas() -> "tuple[Dict[str, float], Dict[str, float]]":
+    """(phase_times, trace_times) accumulated since the previous call, and
+    re-mark.  The boosting loop calls this once per iteration (or once per
+    fused chunk) to scope the per-record timings."""
+    dp = {k: v - _mark_phase.get(k, 0.0) for k, v in _phase_times.items()
+          if v - _mark_phase.get(k, 0.0) > 0.0}
+    dt = {k: v - _mark_trace.get(k, 0.0) for k, v in _trace_times.items()
+          if v - _mark_trace.get(k, 0.0) > 0.0}
+    _mark_phase.clear()
+    _mark_phase.update(_phase_times)
+    _mark_trace.clear()
+    _mark_trace.update(_trace_times)
+    return dp, dt
+
+
+# -------------------------------------------------------------------- sink
+
+def _ensure_sink():
+    """Open the sink on first write.  Deferred so jax.process_index() is
+    consulted AFTER distributed init: only the leader writes."""
+    global _sink_file, _sink_error
+    if _sink_file is not None or _sink_path is None or _sink_error:
+        return _sink_file
+    try:
+        import jax
+        if jax.process_count() > 1 and jax.process_index() != 0:
+            _sink_error = True   # non-leader: never write
+            return None
+    except Exception:
+        pass
+    try:
+        _sink_file = open(_sink_path, "w")
+    except OSError:
+        from .utils import log
+        log.warning("telemetry: cannot open metrics_out=%s; sink disabled"
+                    % _sink_path)
+        _sink_error = True
+    return _sink_file
+
+
+def _round_times(d: Dict[str, float]) -> Dict[str, float]:
+    return {k: round(v, 6) for k, v in sorted(d.items())}
+
+
+def write_record(record: dict) -> None:
+    """Append one raw JSON line to the sink (no-op without a sink).
+
+    Telemetry must never crash training: an I/O failure (disk full, stale
+    mount) disables the sink with a warning, mirroring _ensure_sink's
+    open-failure contract."""
+    global _sink_error, _sink_file
+    f = _ensure_sink()
+    if f is None:
+        return
+    try:
+        f.write(json.dumps(record) + "\n")
+        f.flush()
+    except OSError as e:
+        from .utils import log
+        log.warning("telemetry: write to metrics_out failed (%s); "
+                    "sink disabled" % e)
+        _sink_error = True
+        try:
+            f.close()
+        except OSError:
+            pass
+        _sink_file = None
+
+
+def emit_iteration(iteration: int, phase_times: Dict[str, float],
+                   trace_times: Optional[Dict[str, float]] = None,
+                   eval_metrics: Optional[dict] = None,
+                   extra: Optional[dict] = None) -> dict:
+    """Build and write one per-iteration record.  Canonical phase keys are
+    always present; counters ride cumulatively.  Returns the record."""
+    pt = {k: 0.0 for k in CANONICAL_PHASES}
+    pt.update(phase_times)
+    record = {
+        "iter": int(iteration),
+        "phase_times": _round_times(pt),
+        "counters": dict(sorted(_counters.items())),
+        "eval_metrics": eval_metrics or {},
+    }
+    if trace_times:
+        record["trace_times"] = _round_times(trace_times)
+    if extra:
+        record.update(extra)
+    write_record(record)
+    return record
+
+
+def emit_summary(extra: Optional[dict] = None) -> dict:
+    """Write the end-of-run totals record (cumulative phase/trace times and
+    counters — after cross-host aggregation in multi-process runs)."""
+    record = {
+        "summary": True,
+        "phase_times": _round_times(_phase_times),
+        "phase_counts": dict(sorted(_phase_counts.items())),
+        "trace_times": _round_times(_trace_times),
+        "counters": dict(sorted(_counters.items())),
+    }
+    if extra:
+        record.update(extra)
+    write_record(record)
+    return record

@@ -31,6 +31,21 @@ def set_level(level: int) -> None:
     _level = level
 
 
+def set_level_from_verbosity(verbosity: int) -> None:
+    """The reference's verbosity → level rule (config.cpp:59-70), single-
+    homed: 1 → Info, 0 → Warning, >= 2 → Debug, < 0 → Fatal.  Called at
+    CLI/config startup so ``verbosity=3`` actually enables ``debug``
+    output."""
+    if verbosity == 1:
+        set_level(INFO)
+    elif verbosity == 0:
+        set_level(WARNING)
+    elif verbosity >= 2:
+        set_level(DEBUG)
+    else:
+        set_level(FATAL)
+
+
 def get_level() -> int:
     return _level
 

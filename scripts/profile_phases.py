@@ -1,16 +1,30 @@
 """Per-phase cost attribution for the depthwise training iteration.
 
-Methodology (see memory notes / PROFILE.md): bench-style A/B at full scale
-is the only low-noise ground truth on the tunneled TPU.  This script times
-the SAME fused k-iteration chunk program in variants that stub one phase
-each, so the phase cost falls out as a difference of end-to-end rates:
+Two methodologies:
+
+``--mode=stub`` (the original): bench-style A/B at full scale — the only
+low-noise end-to-end ground truth on the tunneled TPU.  Times the SAME
+fused k-iteration chunk program in variants that stub one phase each, so
+the phase cost falls out as a difference of end-to-end rates:
 
   full        : unmodified train_chunk
   nohist      : histogram_leafbatch replaced by a cheap data-dependent
                 broadcast (keeps the program structure and all downstream
                 consumers; removes the MXU one-hot passes)
 
+``--mode=telemetry``: reads the telemetry subsystem's phase spans
+(lightgbm_tpu/telemetry.py) instead of stubbing.  The fused program is
+host-indivisible, so the span read runs ONE iteration eagerly
+(jax.disable_jit + fence mode — every op executes and blocks as its own
+dispatch) to attribute wall time to histogram / split_find / partition,
+then scales those FRACTIONS onto the separately-measured jitted
+sec/iter.  Eager dispatch overhead inflates the non-histogram tail, so
+treat the stub difference as ground truth for absolutes and the span
+fractions as the per-phase decomposition; ``--cross-check`` runs the
+nohist stub variant too and prints both attributions side by side.
+
 Usage: python scripts/profile_phases.py --rows 11000000 --iters 8
+       python scripts/profile_phases.py --mode=telemetry --rows 200000
 Prints one JSON line per variant.
 """
 from __future__ import annotations
@@ -80,6 +94,72 @@ def run_variant(variant: str, args) -> float:
     return args.iters / elapsed
 
 
+def run_telemetry(args) -> dict:
+    """Span-based attribution: jitted rate for the absolute sec/iter, one
+    eager fenced iteration for the per-phase decomposition."""
+    import jax
+    from lightgbm_tpu import telemetry
+    from lightgbm_tpu.config import OverallConfig
+    from lightgbm_tpu.io.dataset import Dataset
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.objectives import create_objective
+    from lightgbm_tpu.utils import log
+    from bench import make_data
+
+    log.set_stream(sys.stderr)
+    log.set_level(log.WARNING)
+
+    x, y = make_data(args.rows, args.features)
+    ds = Dataset.from_arrays(x, y, max_bin=args.max_bin)
+    cfg = OverallConfig()
+    cfg.set({
+        "objective": "binary", "num_leaves": str(args.leaves),
+        "min_data_in_leaf": "100", "min_sum_hessian_in_leaf": "10.0",
+        "learning_rate": "0.1", "grow_policy": "depthwise",
+        "hist_dtype": args.hist_dtype,
+        "num_iterations": str(2 * args.iters),
+    }, require_data=False)
+    booster = GBDT()
+    booster.init(cfg.boosting_config, ds,
+                 create_objective(cfg.objective_type, cfg.objective_config))
+
+    # jitted end-to-end rate (the absolute scale the fractions map onto)
+    booster.train_chunk(args.iters)
+    jax.block_until_ready(booster.score)
+    start = time.perf_counter()
+    booster.train_chunk(args.iters)
+    jax.block_until_ready(booster.score)
+    sec_per_iter = (time.perf_counter() - start) / args.iters
+
+    # one eager fenced iteration: every op span measures real execution
+    telemetry.enable(fence=True)
+    telemetry.reset()
+    t0 = time.perf_counter()
+    with jax.disable_jit():
+        booster.train_one_iter(is_eval=False)
+    eager_sec = time.perf_counter() - t0
+    snap = telemetry.snapshot()
+    telemetry.disable()
+
+    pt = snap["phase_times"]
+    phases = {k: pt.get(k, 0.0)
+              for k in ("histogram", "split_find", "partition")}
+    fractions = {k: round(v / eager_sec, 4) for k, v in phases.items()}
+    out = {
+        "mode": "telemetry", "rows": args.rows,
+        "hist_dtype": args.hist_dtype,
+        "iters_per_sec": round(1.0 / sec_per_iter, 4),
+        "sec_per_iter": round(sec_per_iter, 4),
+        "eager_sec": round(eager_sec, 4),
+        "phase_times_eager": {k: round(v, 4) for k, v in pt.items()},
+        "phase_fractions": fractions,
+        "est_sec_per_iter": {k: round(f * sec_per_iter, 4)
+                             for k, f in fractions.items()},
+        "counters": dict(sorted(snap["counters"].items())),
+    }
+    return out
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--rows", type=int, default=11_000_000)
@@ -87,11 +167,41 @@ def main():
     p.add_argument("--leaves", type=int, default=255)
     p.add_argument("--max-bin", type=int, default=255)
     p.add_argument("--iters", type=int, default=8)
+    p.add_argument("--mode", default="stub", choices=["stub", "telemetry"])
     p.add_argument("--variant", default="full",
                    choices=["full", "nohist"])
+    p.add_argument("--cross-check", action="store_true",
+                   help="telemetry mode: also run the nohist stub variant "
+                        "(subprocess) and report both histogram "
+                        "attributions side by side")
     p.add_argument("--hist-dtype", default="float32",
                    choices=["float32", "bfloat16", "int8"])
     args = p.parse_args()
+    if args.mode == "telemetry":
+        out = run_telemetry(args)
+        if args.cross_check and args.hist_dtype != "int8":
+            import subprocess
+            full = out["sec_per_iter"]
+            cmd = [sys.executable, os.path.abspath(__file__),
+                   "--mode", "stub", "--variant", "nohist",
+                   "--rows", str(args.rows), "--features",
+                   str(args.features), "--leaves", str(args.leaves),
+                   "--max-bin", str(args.max_bin), "--iters",
+                   str(args.iters), "--hist-dtype", args.hist_dtype]
+            try:
+                res = subprocess.run(cmd, capture_output=True, text=True,
+                                     timeout=3600, check=True)
+                sub = json.loads(res.stdout.strip().splitlines()[-1])
+                stub_hist = full - sub["sec_per_iter"]
+                out["cross_check"] = {
+                    "stub_hist_sec_per_iter": round(stub_hist, 4),
+                    "telemetry_hist_sec_per_iter":
+                        out["est_sec_per_iter"]["histogram"],
+                }
+            except Exception as e:
+                out["cross_check_error"] = f"{type(e).__name__}: {e}"[:400]
+        print(json.dumps(out))
+        return
     if args.variant == "nohist" and args.hist_dtype == "int8":
         # int8 derives root stats FROM the histogram (grower_depthwise);
         # a stubbed histogram would grow a structurally different tree and
@@ -100,7 +210,8 @@ def main():
         raise SystemExit("--variant nohist requires a float hist dtype "
                          "(int8 root stats are histogram-derived)")
     rate = run_variant(args.variant, args)
-    print(json.dumps({"variant": args.variant, "rows": args.rows,
+    print(json.dumps({"variant": args.variant, "mode": "stub",
+                      "rows": args.rows,
                       "hist_dtype": args.hist_dtype,
                       "iters_per_sec": round(rate, 4),
                       "sec_per_iter": round(1.0 / rate, 4)}))

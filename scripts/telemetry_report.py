@@ -1,0 +1,126 @@
+"""Pretty-print a telemetry JSONL file (metrics_out=...) as phase/counter
+tables, so BENCH/PROFILE rounds stop hand-assembling them.
+
+Usage:
+    python scripts/telemetry_report.py metrics.jsonl
+    python scripts/telemetry_report.py --json metrics.jsonl   # machine form
+
+Reads the per-iteration records emitted by lightgbm_tpu/telemetry.py
+({"iter", "phase_times", "counters", "eval_metrics", ...} plus an optional
+trailing {"summary": true, ...} record) and prints:
+
+  - a per-phase table: total seconds, mean ms/iteration, share of the
+    summed phase time (execution spans and trace/compile spans separately),
+  - the final kernel-route counter values (cross-host ``allhosts/`` sums
+    when the run aggregated them),
+  - first/last eval metric values per dataset/metric.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def load(path: str):
+    iters, summary = [], None
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            if rec.get("summary"):
+                summary = rec
+            elif "iter" in rec:
+                iters.append(rec)
+    return iters, summary
+
+
+def _sum_phase(iters, key):
+    total = {}
+    for rec in iters:
+        for k, v in rec.get(key, {}).items():
+            total[k] = total.get(k, 0.0) + v
+    return total
+
+
+def _table(title, totals, n_iters):
+    lines = [title, "-" * len(title)]
+    if not totals:
+        lines.append("(none recorded)")
+        return lines
+    grand = sum(totals.values()) or 1.0
+    width = max(len(k) for k in totals)
+    lines.append(f"{'phase'.ljust(width)}  {'total s':>10}  "
+                 f"{'ms/iter':>10}  {'share':>6}")
+    for k, v in sorted(totals.items(), key=lambda kv: -kv[1]):
+        per = 1000.0 * v / max(n_iters, 1)
+        lines.append(f"{k.ljust(width)}  {v:>10.4f}  {per:>10.2f}  "
+                     f"{100.0 * v / grand:>5.1f}%")
+    return lines
+
+
+def report(path: str, as_json: bool = False) -> int:
+    iters, summary = load(path)
+    if not iters and summary is None:
+        print(f"no telemetry records in {path}", file=sys.stderr)
+        return 1
+    n = len(iters)
+    exec_totals = _sum_phase(iters, "phase_times")
+    trace_totals = _sum_phase(iters, "trace_times")
+    counters = (summary or (iters[-1] if iters else {})).get("counters", {})
+    evals = {}
+    for rec in iters:
+        for k, v in rec.get("eval_metrics", {}).items():
+            evals.setdefault(k, []).append(v)
+
+    if as_json:
+        print(json.dumps({
+            "iterations": n,
+            "phase_times_total": {k: round(v, 6)
+                                  for k, v in sorted(exec_totals.items())},
+            "trace_times_total": {k: round(v, 6)
+                                  for k, v in sorted(trace_totals.items())},
+            "counters": dict(sorted(counters.items())),
+            "eval_first_last": {k: [v[0], v[-1]]
+                                for k, v in sorted(evals.items())},
+        }))
+        return 0
+
+    out = [f"telemetry report: {path}  ({n} iteration records"
+           + (", summary present)" if summary else ")"), ""]
+    out += _table("Execution phases", exec_totals, n)
+    out.append("")
+    out += _table("Trace/compile attribution", trace_totals, n)
+    out.append("")
+    out.append("Kernel-route counters")
+    out.append("---------------------")
+    if counters:
+        width = max(len(k) for k in counters)
+        for k, v in sorted(counters.items()):
+            out.append(f"{k.ljust(width)}  {v}")
+    else:
+        out.append("(none recorded)")
+    if evals:
+        out.append("")
+        out.append("Eval metrics (first -> last)")
+        out.append("----------------------------")
+        width = max(len(k) for k in evals)
+        for k, v in sorted(evals.items()):
+            out.append(f"{k.ljust(width)}  {v[0]} -> {v[-1]}")
+    print("\n".join(out))
+    return 0
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("path", help="telemetry JSONL file (metrics_out=...)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable aggregate instead of tables")
+    args = p.parse_args()
+    return report(args.path, as_json=args.json)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

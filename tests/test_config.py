@@ -91,3 +91,32 @@ def test_config_file_and_argv_priority(tmp_path):
 def test_metric_dedup():
     cfg = _set({"metric": "auc,auc,binary_logloss"})
     assert cfg.metric_types == ["auc", "binary_logloss"]
+
+
+def test_verbosity_wires_log_level(capsys):
+    """verbosity=3 (the ``verbosity`` alias included) must actually enable
+    log.debug output at config/CLI startup — the reference's rule
+    (config.cpp:59-70), single-homed in log.set_level_from_verbosity."""
+    from lightgbm_tpu.utils import log
+    old = log.get_level()
+    try:
+        _set({"verbosity": "3"})
+        assert log.get_level() == log.DEBUG
+        log.debug("debug-visible")
+        assert "debug-visible" in capsys.readouterr().out
+        _set({"verbose": "0"})
+        assert log.get_level() == log.WARNING
+        log.debug("debug-hidden")
+        assert "debug-hidden" not in capsys.readouterr().out
+        _set({"verbosity": "-1"})
+        assert log.get_level() == log.FATAL
+    finally:
+        log.set_level(old)
+
+
+def test_metrics_out_option(tmp_path):
+    cfg = _set({"metrics_out": str(tmp_path / "m.jsonl"),
+                "metrics_fence": "true"})
+    assert cfg.io_config.metrics_out == str(tmp_path / "m.jsonl")
+    assert cfg.io_config.metrics_fence is True
+    assert _set({}).io_config.metrics_out == ""

@@ -1,0 +1,215 @@
+"""Telemetry subsystem tests (ISSUE 1): route counters, span nesting,
+zero-overhead disabled mode, the JSONL sink's per-iteration schema, and the
+tier-1 invariant that instrumentation never perturbs training numerics."""
+import json
+import time
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import telemetry
+from lightgbm_tpu.io.dataset import Dataset
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    """Telemetry is process-global state: every test starts disabled/zeroed
+    and leaves nothing armed for the rest of the suite."""
+    telemetry.disable()
+    telemetry.reset()
+    yield
+    telemetry.disable()
+    telemetry.reset()
+
+
+def _data(n=1200, seed=0, features=6):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, features)
+    y = (x[:, 0] + 0.5 * x[:, 1] + 0.1 * rng.randn(n) > 0).astype(np.float32)
+    return x, y
+
+
+BASE = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 20,
+        "min_sum_hessian_in_leaf": 1.0, "learning_rate": 0.2}
+
+
+# ----------------------------------------------------------------- counters
+
+def test_counters_increment_on_forced_fallback(monkeypatch):
+    """LGBM_TPU_NO_PALLAS=1 must leave a runtime record: the env-trip
+    counter and the XLA fallback route counter both tick."""
+    monkeypatch.setenv("LGBM_TPU_NO_PALLAS", "1")
+    telemetry.enable()
+    from lightgbm_tpu.ops.histogram import histogram_leafbatch
+    bins = jnp.zeros((2, 16), jnp.uint8)
+    g = jnp.ones((16,), jnp.float32)
+    h = jnp.ones((16,), jnp.float32)
+    cid = jnp.zeros((16,), jnp.int32)
+    ok = jnp.ones((16,), bool)
+    out = histogram_leafbatch(bins, g, h, cid, ok, 1, 4,
+                              compute_dtype="int8")
+    assert out.shape == (1, 2, 4, 3)
+    c = telemetry.counters()
+    assert c.get("hist/env_no_pallas", 0) >= 1
+    assert c.get("hist/xla_int8", 0) >= 1
+    # the partition eligibility rule trips the same hatch
+    from lightgbm_tpu.ops.compact import pallas_partition_ok
+    assert pallas_partition_ok() is False
+    assert telemetry.counters().get("partition/env_no_pallas", 0) >= 1
+
+
+def test_route_counters_float_fallback():
+    telemetry.enable()
+    from lightgbm_tpu.ops.histogram import histogram_leafbatch
+    bins = jnp.zeros((2, 16), jnp.uint8)
+    g = jnp.ones((16,), jnp.float32)
+    h = jnp.ones((16,), jnp.float32)
+    histogram_leafbatch(bins, g, h, jnp.zeros((16,), jnp.int32),
+                        jnp.ones((16,), bool), 1, 4,
+                        compute_dtype=jnp.float32)
+    c = telemetry.counters()
+    # CPU backend: Pallas ineligible, einsum fallback taken
+    assert c.get("hist/xla_einsum", 0) >= 1
+    assert c.get("hist/pallas_ineligible", 0) >= 1
+
+
+# -------------------------------------------------------------------- spans
+
+def test_spans_nest_correctly():
+    telemetry.enable()
+    with telemetry.span("outer"):
+        time.sleep(0.002)
+        with telemetry.span("inner"):
+            time.sleep(0.002)
+    snap = telemetry.snapshot()
+    assert snap["phase_times"]["outer"] >= snap["phase_times"]["inner"] > 0
+    assert snap["phase_counts"] == {"outer": 1, "inner": 1}
+    # re-entrant same-name spans are suppressed (recursive helpers must
+    # not double-count wall time under one name)
+    with telemetry.span("outer"):
+        with telemetry.span("outer"):
+            time.sleep(0.001)
+    assert telemetry.snapshot()["phase_counts"]["outer"] == 2
+    # the stack unwinds on exceptions
+    with pytest.raises(RuntimeError):
+        with telemetry.span("boom"):
+            raise RuntimeError("x")
+    with telemetry.span("after"):
+        pass
+    assert "after" in telemetry.snapshot()["phase_times"]
+
+
+def test_disabled_mode_records_nothing(tmp_path):
+    assert not telemetry.enabled()
+    with telemetry.span("phantom"):
+        pass
+    telemetry.count("phantom_counter")
+    snap = telemetry.snapshot()
+    assert snap["phase_times"] == {} and snap["counters"] == {}
+    # a train without metrics_out writes no file and leaves no records
+    x, y = _data()
+    ds = Dataset.from_arrays(x, y, max_bin=32)
+    lgb.train(dict(BASE, num_iterations=2), ds)
+    snap = telemetry.snapshot()
+    assert snap["phase_times"] == {} and snap["counters"] == {}
+
+
+# --------------------------------------------------------------------- sink
+
+def _check_record_schema(rec):
+    assert isinstance(rec["iter"], int)
+    for key in telemetry.CANONICAL_PHASES:
+        assert key in rec["phase_times"]
+    for v in rec["phase_times"].values():
+        assert isinstance(v, (int, float)) and v >= 0
+    assert isinstance(rec["counters"], dict)
+    assert isinstance(rec["eval_metrics"], dict)
+
+
+def test_jsonl_sink_per_iteration_schema(tmp_path):
+    """3-iteration CPU train (per-iteration leaf-wise path): one
+    schema-valid record per iteration plus the summary.
+
+    Route counters fire at TRACE time, so the dataset shape must be unique
+    to this test — a shape any earlier test already compiled would replay
+    its cached program and record no new route decisions."""
+    x, y = _data(n=1357, features=7)
+    ds = Dataset.from_arrays(x, y, max_bin=48)
+    path = str(tmp_path / "m.jsonl")
+    lgb.train(dict(BASE, num_iterations=3, num_leaves=13,
+                   metric="binary_logloss",
+                   is_training_metric="true", metrics_out=path), ds)
+    telemetry.disable()
+    recs = [json.loads(line) for line in open(path)]
+    iter_recs = [r for r in recs if "iter" in r]
+    assert [r["iter"] for r in iter_recs] == [1, 2, 3]
+    for rec in iter_recs:
+        _check_record_schema(rec)
+    # eval metrics ride the records
+    assert any("training/" in k for r in iter_recs
+               for k in r["eval_metrics"])
+    # route counters are present and monotonic across records
+    hist_counts = [sum(v for k, v in r["counters"].items()
+                       if k.startswith("hist/")) for r in iter_recs]
+    assert hist_counts[0] > 0
+    assert hist_counts == sorted(hist_counts)
+    assert recs[-1].get("summary") is True
+
+
+def test_jsonl_sink_chunked_one_record_per_iteration(tmp_path):
+    """10-iteration depthwise CPU train rides the fused chunk path; the
+    sink still gets exactly one record per iteration (amortized)."""
+    x, y = _data()
+    ds = Dataset.from_arrays(x, y, max_bin=32)
+    path = str(tmp_path / "m.jsonl")
+    lgb.train(dict(BASE, num_iterations=10, grow_policy="depthwise",
+                   metrics_out=path), ds)
+    telemetry.disable()
+    recs = [json.loads(line) for line in open(path)]
+    iter_recs = [r for r in recs if "iter" in r]
+    assert [r["iter"] for r in iter_recs] == list(range(1, 11))
+    for rec in iter_recs:
+        _check_record_schema(rec)
+        assert rec["amortized_over"] >= 1
+
+
+def test_sink_closed_after_train_no_leak(tmp_path):
+    """A train() that armed the sink closes it: a later train() without
+    metrics_out must not append records to the first run's file."""
+    x, y = _data()
+    ds = Dataset.from_arrays(x, y, max_bin=32)
+    path = str(tmp_path / "m.jsonl")
+    lgb.train(dict(BASE, num_iterations=2, metrics_out=path), ds)
+    assert not telemetry.sink_active()
+    n_lines = len(open(path).read().splitlines())
+    ds2 = Dataset.from_arrays(x, y, max_bin=32)
+    lgb.train(dict(BASE, num_iterations=2), ds2)
+    assert len(open(path).read().splitlines()) == n_lines
+
+
+# ---------------------------------------------------- numerics non-perturbation
+
+def test_scores_identical_with_telemetry_on_vs_off(tmp_path):
+    """Tier-1 invariant: instrumentation must not perturb numerics or jit
+    caching — train_one_iter produces bit-identical scores either way."""
+    x, y = _data(seed=3)
+    params = dict(BASE, num_iterations=4, bagging_fraction=0.7,
+                  bagging_freq=1)
+
+    def scores(with_telemetry):
+        if with_telemetry:
+            telemetry.enable(str(tmp_path / "on.jsonl"), fence=True)
+        else:
+            telemetry.disable()
+        telemetry.reset()
+        ds = Dataset.from_arrays(x, y, max_bin=32)
+        booster = lgb.train(params, ds)
+        out = np.asarray(booster.score)
+        telemetry.disable()
+        return out
+
+    off = scores(False)
+    on = scores(True)
+    np.testing.assert_array_equal(off, on)
