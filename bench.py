@@ -149,18 +149,24 @@ def main() -> int:
     # telemetry WITHOUT a sink: kernel-route counters and trace/compile
     # spans are recorded (route decisions fire during the warmup compile),
     # and the only cost inside the timed region is one host perf_counter
-    # span per chunk — the JSON gains a phase-breakdown block for free
-    telemetry.enable()
+    # span per chunk — the JSON gains a phase-breakdown block for free.
+    # memory=True adds the span-boundary HBM gauges (a host-side stats
+    # read per chunk) so BENCH_*.json rounds carry the memory trajectory;
+    # the armed telemetry also resolves health="auto" ON, so the chunk
+    # programs accumulate the in-program health vector (a handful of [C,N]
+    # reductions per iteration — noise next to the histogram passes)
+    telemetry.enable(memory=True)
 
     x, y = make_data(args.rows, args.features)
     ds = Dataset.from_arrays(x, y, max_bin=args.max_bin)
 
-    def run_config(grow_policy: str, hist_dtype: str,
-                   iters: int) -> "list[float]":
+    def run_config(grow_policy: str, hist_dtype: str, iters: int):
         """Train one configuration (fresh booster, shared dataset) and
-        return per-round timed iters/sec samples: one warmup round
-        compiles + caches the programs, then ``--repeats`` identical
-        rounds are timed (median/spread computed by the caller)."""
+        return ``(samples, health_summary)``: per-round timed iters/sec
+        samples — one warmup round compiles + caches the programs, then
+        ``--repeats`` identical rounds are timed (median/spread computed
+        by the caller) — plus the booster's cumulative health totals
+        (None when the monitor was off, e.g. the leaf-wise path)."""
         params = {
             "objective": "binary",
             "num_leaves": str(args.leaves),
@@ -173,6 +179,13 @@ def main() -> int:
             "num_iterations": str(2 * iters),
         }
         if grow_policy == "leafwise":
+            # leaf-wise times train_one_iter per iteration: the health
+            # monitor's separate dispatch + host fetch per iteration is
+            # exactly the tunneled-TPU round-trip cost this path is
+            # dominated by, so it would skew the headline vs prior BENCH
+            # rounds — health off here (the chunked path keeps it: its
+            # vector rides IN the fused program and the readback)
+            params["health"] = "false"
             # keep every leaf-wise dispatch under the environment's ~60 s
             # execution watchdog: segment the per-tree split loop so each
             # dispatch stays ~30 s (bit-identical trees,
@@ -212,15 +225,16 @@ def main() -> int:
             for rep in range(max(1, args.repeats)):
                 done = 0
                 stopped = False
-                start = time.time()
+                start = time.perf_counter()
                 while done < iters and (done == 0
-                                        or time.time() - start < 60.0):
+                                        or time.perf_counter() - start
+                                        < 60.0):
                     if booster.train_one_iter(is_eval=False):
                         stopped = True
                         break
                     jax.block_until_ready(booster.score)
                     done += 1
-                elapsed = time.time() - start
+                elapsed = time.perf_counter() - start
                 if stopped:
                     # no splittable leaf.  First round: the rate would be
                     # meaningless (and the aborted attempt's wall time
@@ -237,7 +251,7 @@ def main() -> int:
                 if done == 0:
                     raise RuntimeError("no leafwise iteration completed")
                 samples.append(done / elapsed)
-            return samples
+            return samples, booster.health_summary()
 
         def run_chunks():
             booster.train_chunk(iters)
@@ -246,12 +260,13 @@ def main() -> int:
         run_chunks()
         samples = []
         for _ in range(max(1, args.repeats)):
-            start = time.time()
+            start = time.perf_counter()
             run_chunks()
-            samples.append(iters / (time.time() - start))
-        return samples
+            samples.append(iters / (time.perf_counter() - start))
+        return samples, booster.health_summary()
 
-    samples = run_config(args.grow_policy, args.hist_dtype, args.iters)
+    samples, health_summary = run_config(args.grow_policy, args.hist_dtype,
+                                         args.iters)
     iters_per_sec = float(np.median(samples))
     snap = telemetry.snapshot()
     out = {
@@ -289,6 +304,27 @@ def main() -> int:
                         for k, v in sorted(snap["trace_times"].items())},
         "counters": dict(sorted(snap["counters"].items())),
     }
+
+    # memory trajectory (ISSUE 2): peak HBM watermark + dataset residency,
+    # so BENCH_*.json rounds stop hand-measuring footprints (PROFILE.md)
+    mem = snap.get("memory") or {}
+    out["memory"] = {
+        "peak_bytes_in_use": mem.get("peak_bytes_in_use", 0),
+        "source": mem.get("source", "unavailable"),
+        "residency": mem.get("residency", {}),
+    }
+    # health summary: anomaly count + NaN/saturation totals for the run
+    # (health.HealthMonitor; nonzero anomalies invalidate a bench round)
+    if health_summary is not None:
+        out["health"] = {
+            "anomalous_iterations": health_summary.get(
+                "anomalous_iterations", 0),
+            "grad_nan": health_summary.get("grad_nan", 0),
+            "quant_sat": health_summary.get("quant_sat", 0),
+            "score_max_abs": round(
+                float(health_summary.get("score_max_abs", 0.0)), 4),
+            "zero_gain_splits": health_summary.get("zero_gain_splits", 0),
+        }
 
     # Additional configurations run as SUBPROCESSES: a leaf-wise 255-leaf
     # tree is ONE dispatch, and when the tunneled TPU's dispatch overhead

@@ -54,10 +54,12 @@ def train(params: dict, train_set: Dataset, valid_sets=(), valid_names=None):
 
     config = OverallConfig()
     config.set({k: str(v) for k, v in params.items()}, require_data=False)
-    armed_telemetry = bool(config.io_config.metrics_out)
+    io = config.io_config
+    mem_on = io.memory_stats_enabled()
+    armed_telemetry = bool(io.metrics_out) or mem_on
     if armed_telemetry:
-        telemetry.enable(config.io_config.metrics_out,
-                         fence=config.io_config.metrics_fence)
+        telemetry.enable(io.metrics_out or None,
+                         fence=io.metrics_fence, memory=mem_on)
         # fresh registry per armed run: a second train() in the same
         # process must not ship the first run's counters in its records
         telemetry.reset()

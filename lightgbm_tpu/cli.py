@@ -31,13 +31,16 @@ class Application:
         if self.config.num_threads > 0:
             from .native import lib as native_lib
             native_lib.set_num_threads(self.config.num_threads)
-        if self.config.io_config.metrics_out:
-            telemetry.enable(self.config.io_config.metrics_out,
-                             fence=self.config.io_config.metrics_fence)
+        io = self.config.io_config
+        # memory gauges resolve "auto" → on whenever a sink is configured
+        # (memory_stats=true arms them standalone, snapshot()-only)
+        mem_on = io.memory_stats_enabled()
+        if io.metrics_out or mem_on:
+            telemetry.enable(io.metrics_out or None,
+                             fence=io.metrics_fence, memory=mem_on)
             telemetry.reset()
-            log.debug("telemetry armed: metrics_out=%s fence=%s"
-                      % (self.config.io_config.metrics_out,
-                         self.config.io_config.metrics_fence))
+            log.debug("telemetry armed: metrics_out=%s fence=%s memory=%s"
+                      % (io.metrics_out, io.metrics_fence, mem_on))
         self.boosting: GBDT = None
         self.objective = None
         self.train_data = None

@@ -146,6 +146,12 @@ class IOConfig:
     # slows training, never issues extra dispatches)
     metrics_out: str = ""
     metrics_fence: bool = False
+    # Memory gauges (ISSUE 2): sample device.memory_stats() at telemetry
+    # span boundaries (per-phase byte deltas + peak bytes_in_use
+    # watermark) and emit a ``memory`` block in the JSONL records plus a
+    # one-shot dataset-residency report at train start.  "auto" (default)
+    # = on whenever metrics_out is set; "true"/"false" force it.
+    memory_stats: str = "auto"
     output_result: str = "LightGBM_predict_result.txt"
     input_model: str = ""
     input_init_score: str = ""
@@ -166,6 +172,13 @@ class IOConfig:
     group_column: str = ""
     ignore_column: str = ""
 
+    def memory_stats_enabled(self) -> bool:
+        """The ``memory_stats=`` resolution rule, single-homed (cli.py and
+        lgb.train both consult it): "auto" follows the sink — gauges on
+        whenever ``metrics_out`` is set; "true"/"false" force it."""
+        return (self.memory_stats == "true"
+                or (self.memory_stats == "auto" and bool(self.metrics_out)))
+
     def set(self, params: Dict[str, str], require_data: bool = True) -> None:
         self.max_bin = _get_int(params, "max_bin", self.max_bin)
         log.check(self.max_bin > 0, "max_bin should be > 0")
@@ -179,6 +192,11 @@ class IOConfig:
         self.metrics_out = _get_str(params, "metrics_out", self.metrics_out)
         self.metrics_fence = _get_bool(params, "metrics_fence",
                                        self.metrics_fence)
+        if "memory_stats" in params:
+            value = params["memory_stats"].lower()
+            log.check(value in ("auto", "true", "false"),
+                      "memory_stats must be auto, true or false")
+            self.memory_stats = value
         self.num_model_predict = _get_int(params, "num_model_predict", self.num_model_predict)
         self.is_pre_partition = _get_bool(params, "is_pre_partition", self.is_pre_partition)
         self.is_enable_sparse = _get_bool(params, "is_enable_sparse", self.is_enable_sparse)
@@ -387,6 +405,21 @@ class BoostingConfig:
     early_stopping_round: int = 0
     num_class: int = 1
     tree_learner: str = "serial"
+    # Training-health monitor (ISSUE 2, lightgbm_tpu/health.py): an
+    # in-program health vector (NaN/Inf counts in gradients/hessians/raw
+    # scores, int8 quantization saturation, score-magnitude watermark)
+    # plus tree-derived counts (zero-gain splits, empty leaves), fetched
+    # once per iteration and emitted as a ``health`` block in the JSONL
+    # sink.  "auto" (default) = on whenever telemetry is armed
+    # (metrics_out=); "true"/"false" force it.
+    health: str = "auto"
+    # policy on health anomalies (nonzero NaN/Inf counts, eval
+    # divergence): "warn" logs once per anomaly kind, "halt" raises a
+    # clean TrainingHealthError, "record" only writes the sink block
+    on_anomaly: str = "warn"
+    # eval-metric divergence detection: k consecutive worsening
+    # iterations of any tracked metric flag an anomaly (0 = disabled)
+    health_divergence_rounds: int = 0
     tree_config: TreeConfig = dataclasses.field(default_factory=TreeConfig)
 
     def set(self, params: Dict[str, str]) -> None:
@@ -409,6 +442,20 @@ class BoostingConfig:
                                                     self.is_provide_training_metric)
         self.num_class = _get_int(params, "num_class", self.num_class)
         log.check(self.num_class >= 1, "num_class should be >= 1")
+        if "health" in params:
+            value = params["health"].lower()
+            log.check(value in ("auto", "true", "false"),
+                      "health must be auto, true or false")
+            self.health = value
+        if "on_anomaly" in params:
+            value = params["on_anomaly"].lower()
+            log.check(value in ("warn", "halt", "record"),
+                      "on_anomaly must be warn, halt or record")
+            self.on_anomaly = value
+        self.health_divergence_rounds = _get_int(
+            params, "health_divergence_rounds", self.health_divergence_rounds)
+        log.check(self.health_divergence_rounds >= 0,
+                  "health_divergence_rounds should be >= 0")
         if "tree_learner" in params:
             value = params["tree_learner"].lower()
             if value == "serial":

@@ -79,6 +79,9 @@ class GBDT:
         # telemetry iteration records (captured only while a sink is
         # active, _consume_metric_values)
         self._last_eval_values = {}
+        # training-health monitor (ISSUE 2, lightgbm_tpu/health.py) —
+        # created in init() when the health= setting resolves on
+        self._health_monitor = None
 
     # ------------------------------------------------------------------ init
 
@@ -260,6 +263,70 @@ class GBDT:
             for metric in self.training_metrics:
                 metric.init("training", train_data.metadata, N)
 
+        # training-health monitor (ISSUE 2): "auto" follows the telemetry
+        # registry, so metrics_out= runs get health blocks with no extra
+        # flag; health=true forces it on for library users without a sink
+        from .. import health as _health
+        if _health.resolve_enabled(getattr(boosting_config, "health",
+                                           "auto")):
+            self._health_monitor = _health.HealthMonitor(
+                on_anomaly=getattr(boosting_config, "on_anomaly", "warn"),
+                divergence_rounds=getattr(boosting_config,
+                                          "health_divergence_rounds", 0),
+                quantized=self.tree_config.hist_dtype == "int8")
+        else:
+            self._health_monitor = None
+
+        # one-shot dataset-residency report (memory gauges), filed at
+        # train start — after add_valid_dataset calls — by _file_residency
+        self._residency_filed = False
+
+    def _file_residency(self) -> None:
+        """File the one-shot dataset-residency report on the first
+        training entry (any path), so BENCH/PROFILE rounds stop
+        hand-measuring HBM footprints."""
+        if self._residency_filed or not telemetry.memory_enabled():
+            return
+        self._residency_filed = True
+        telemetry.set_residency(self._residency_report())
+
+    def _residency_report(self) -> dict:
+        """Static device-memory footprint of this booster's training state:
+        the bin matrix, row-aligned score/metadata arrays, and the
+        histogram scratch the configured grower will carry."""
+        F, B = self.num_features, self.num_bins_max
+        L = _effective_num_leaves(self.tree_config)
+        md = self.train_data.metadata
+        md_bytes = sum(int(np.asarray(a).nbytes) for a in
+                       (md.label, md.weights, md.init_score,
+                        md.query_boundaries) if a is not None)
+        if self.tree_config.grow_policy == "depthwise":
+            # widest level: P parent slots, each [F, B, 3] f32, live twice
+            # across the subtraction (hists + hist_small)
+            from .grower_depthwise import num_levels
+            P = 1 << max(num_levels(L, self.tree_config.max_depth) - 1, 0)
+            hist_scratch = 2 * P * F * B * 3 * 4
+        else:
+            # leaf-wise: the [L, F, B, 3] f32 histogram cache
+            hist_scratch = L * F * B * 3 * 4
+        return {
+            "num_rows": int(self.num_data),
+            "num_features": int(F),
+            "num_bins_max": int(B),
+            "bin_matrix_bytes": int(self.bins_device.nbytes),
+            "score_bytes": int(self.score.nbytes),
+            "metadata_bytes": int(md_bytes),
+            "hist_scratch_bytes": int(hist_scratch),
+            "valid_bins_bytes": int(sum(e["bins"].nbytes
+                                        for e in self.valid_datasets)),
+        }
+
+    def health_summary(self):
+        """Cumulative health totals (None when the monitor is off) —
+        bench.py attaches this to its JSON line."""
+        return (self._health_monitor.summary()
+                if self._health_monitor is not None else None)
+
     def _mp_global_metadata(self):
         """Cached all-process Metadata view (labels/weights/query layout in
         process order — the compacted-global row coordinate system)."""
@@ -355,6 +422,8 @@ class GBDT:
     def train_one_iter(self, is_eval: bool = True) -> bool:
         """GBDT::TrainOneIter (gbdt.cpp:167-214).  Returns True when
         training must stop (early stopping or no splittable leaf)."""
+        self._file_residency()
+        mon = self._health_monitor
         with telemetry.span("gradient") as sp:
             grad, hess = self.objective.get_gradients(
                 self.score if self.num_class > 1 else self.score[0])
@@ -443,24 +512,54 @@ class GBDT:
             with telemetry.span("model_readback"):
                 host = jax.device_get(small)
             num_leaves = int(host.num_leaves)
+            if mon is not None:
+                # tree-derived health counts ride the readback for free
+                mon.add_tree(num_leaves, host.split_gain, host.leaf_count)
             if num_leaves <= 1:
                 log.info("Can't training anymore, there isn't any leaf meets "
                          "split requirements.")
+                if mon is not None:
+                    # the iteration produced no tree, but its gradients may
+                    # be the REASON (NaN/Inf gains reject every split):
+                    # record the health block and apply the policy before
+                    # stopping, so the stop is explained, not silent
+                    hvec = mon.grad_health_async(grad, hess, self.score)
+                    block = mon.assemble(hvec)
+                    if telemetry.sink_active():
+                        dp, dt = telemetry.take_phase_deltas()
+                        telemetry.emit_iteration(
+                            self.iter + 1, dp, dt,
+                            eval_metrics=self._last_eval_values,
+                            health=block,
+                            memory=telemetry.take_memory_record(),
+                            extra={"stopped": "degenerate_tree"})
+                    mon.apply_policy(block, self.iter + 1)
                 return True
 
             tree = self._to_host_tree(host)
             tree.shrinkage(self.gbdt_config.learning_rate)
             self.models.append(tree)
 
+        # dispatch the health program over this iteration's arrays (async:
+        # the host copy overlaps the eval phase; fetched at assemble)
+        hvec = (mon.grad_health_async(grad, hess, self.score)
+                if mon is not None else None)
         met_early_stopping = False
         if is_eval:
             with telemetry.span("eval"):
                 met_early_stopping = self.output_metric(self.iter + 1)
         self.iter += 1
+        health_block = mon.assemble(hvec) if mon is not None else None
         if telemetry.sink_active():
             dp, dt = telemetry.take_phase_deltas()
             telemetry.emit_iteration(self.iter, dp, dt,
-                                     eval_metrics=self._last_eval_values)
+                                     eval_metrics=self._last_eval_values,
+                                     health=health_block,
+                                     memory=telemetry.take_memory_record())
+        if mon is not None:
+            # AFTER the record is written: a halt must not lose the
+            # record that explains it
+            mon.apply_policy(health_block, self.iter)
         if met_early_stopping:
             log.info("Early stopping at iteration %d, the best iteration "
                      "round is %d"
@@ -522,7 +621,10 @@ class GBDT:
             from ..parallel.learners import aggregate_telemetry
             aggregate_telemetry()
         if telemetry.sink_active():
-            telemetry.emit_summary(extra={"iterations": self.iter})
+            extra = {"iterations": self.iter}
+            if self._health_monitor is not None:
+                extra["health"] = self._health_monitor.summary()
+            telemetry.emit_summary(extra=extra)
 
     # ------------------------------------------------------- chunked training
 
@@ -645,6 +747,8 @@ class GBDT:
                 "must have a device formulation (metrics/device.py) when "
                 "evaluation is consumed (see chunk_supported); use "
                 "train_one_iter / run_training")
+        self._file_residency()
+        mon = self._health_monitor
         has_bag = self._use_bagging
         has_ff = self.tree_config.feature_fraction < 1.0
         obj_key, obj_params, grad_fn = self.objective.chunk_spec()
@@ -668,6 +772,7 @@ class GBDT:
                                               "needs_global_score", False)}
             if self._mp:
                 extra["shard_layout"] = self._shard_layout
+            extra["health"] = mon is not None
             fn, num_shards = self._learner.chunk_program(
                 self, obj_key, grad_fn, obj_params, has_bag, has_ff,
                 train_metric_fns=tuple(s[2] for s in train_specs),
@@ -694,7 +799,9 @@ class GBDT:
                 has_bag=has_bag, has_ff=has_ff,
                 train_metric_fns=tuple(s[2] for s in train_specs),
                 valid_metric_fns=tuple(tuple(s[2] for s in specs)
-                                       for specs in valid_specs))
+                                       for specs in valid_specs),
+                health_fn=(mon.chunk_health_fn(None)
+                           if mon is not None else None))
 
         C, N, F = self.num_class, self.num_data, self.num_features
         # snapshots for early/degenerate stops and tail truncation: training
@@ -757,7 +864,7 @@ class GBDT:
                 valid_in = tuple(tuple(s[1] for s in specs)
                                  for specs in valid_specs)
             with telemetry.span("train_chunk") as sp:
-                new_score, vscores_out, stacked, mvals = sp.fence(fn(
+                new_score, vscores_out, stacked, mvals, hvals = sp.fence(fn(
                     self.score, self.bins_device, self.num_bins_device,
                     own, ownmask, row_masks, feat_masks, obj_in,
                     train_in,
@@ -798,7 +905,7 @@ class GBDT:
             score_in = (jnp.pad(self.score, ((0, 0), (0, pad)))
                         if pad else self.score)
             with telemetry.span("train_chunk") as sp:
-                new_score, vscores_out, stacked, mvals = sp.fence(fn(
+                new_score, vscores_out, stacked, mvals, hvals = sp.fence(fn(
                     score_in, bins_p, self.num_bins_device, valid_rows,
                     row_masks, feat_masks, obj_p,
                     tuple(s[1] for s in train_specs),
@@ -809,7 +916,7 @@ class GBDT:
             self.score = new_score[:, :N] if pad else new_score
         else:
             with telemetry.span("train_chunk") as sp:
-                self.score, vscores_out, stacked, mvals = sp.fence(fn(
+                self.score, vscores_out, stacked, mvals, hvals = sp.fence(fn(
                     self.score, self.bins_device, self.num_bins_device,
                     row_masks, feat_masks, obj_params,
                     tuple(s[1] for s in train_specs),
@@ -820,23 +927,32 @@ class GBDT:
         with telemetry.span("model_readback"):
             host = jax.device_get(stacked)
             mvals_host = np.asarray(mvals) if eval_each else None
+            # stacked [k, H] in-program health vectors, one per iteration
+            hvals_host = np.asarray(hvals) if mon is not None else None
 
         # per-iteration telemetry records: the fused program's phases are
         # indivisible from the host, so its wall time is amortized evenly
-        # across the chunk's iterations (marked "amortized_over")
+        # across the chunk's iterations (marked "amortized_over"); the
+        # memory gauges are LEVELS, not durations — every record of the
+        # chunk carries the same post-chunk sample
         if telemetry.sink_active():
             _chunk_dp, _chunk_dt = telemetry.take_phase_deltas()
+            _chunk_mem = telemetry.take_memory_record()
             _scale = 1.0 / max(k, 1)
 
-            def _emit(i: int) -> None:
+            def _emit(i: int, health=None, stopped=None) -> None:
+                extra = {"amortized_over": k}
+                if stopped:
+                    extra["stopped"] = stopped
                 telemetry.emit_iteration(
                     self.iter + i + 1,
                     {p: v * _scale for p, v in _chunk_dp.items()},
                     {p: v * _scale for p, v in _chunk_dt.items()},
                     eval_metrics=self._last_eval_values,
-                    extra={"amortized_over": k})
+                    health=health, memory=_chunk_mem,
+                    extra=extra)
         else:
-            def _emit(i: int) -> None:
+            def _emit(i: int, health=None, stopped=None) -> None:
                 pass
 
         keep_iters = k if limit < 0 else min(k, limit)
@@ -844,7 +960,10 @@ class GBDT:
         for i in range(keep_iters):
             for cls in range(C):
                 sub = jax.tree.map(lambda a: a[i, cls], host)
-                if int(sub.num_leaves) <= 1:
+                nl = int(sub.num_leaves)
+                if mon is not None:
+                    mon.add_tree(nl, sub.split_gain, sub.leaf_count)
+                if nl <= 1:
                     log.info("Can't training anymore, there isn't any leaf "
                              "meets split requirements.")
                     # the degenerate pair consumed its RNG draws but
@@ -852,7 +971,19 @@ class GBDT:
                     self._rollback_chunk(i * C + cls + 1, i * C + cls,
                                          bag_state, ff_states, score_before,
                                          valid_before)
-                    self.iter += i
+                    if mon is not None:
+                        # explain the stop (NaN/Inf gains reject every
+                        # split): assemble this iteration's in-program
+                        # vector and apply the policy before returning —
+                        # marked like the per-iteration path so the
+                        # rolled-back record is distinguishable from a
+                        # trained iteration
+                        block = mon.assemble(hvals_host[i])
+                        _emit(i, health=block, stopped="degenerate_tree")
+                        self.iter += i
+                        mon.apply_policy(block, self.iter + 1)
+                    else:
+                        self.iter += i
                     return True
                 tree = self._to_host_tree(sub)
                 tree.shrinkage(self.gbdt_config.learning_rate)
@@ -863,7 +994,9 @@ class GBDT:
                 if self._consume_metric_values(self.iter + i + 1,
                                                train_vals, valid_vals):
                     kept = i + 1
-                    _emit(i)
+                    health_i = (mon.assemble(hvals_host[i])
+                                if mon is not None else None)
+                    _emit(i, health=health_i)
                     log.info("Early stopping at iteration %d, the best "
                              "iteration round is %d"
                              % (self.iter + kept, self.iter + kept - esr))
@@ -881,8 +1014,32 @@ class GBDT:
                                           if self._host_inputs else s)
                     del self.models[len(self.models) - esr * C:]
                     self.iter += kept
+                    if mon is not None:
+                        mon.apply_policy(health_i, self.iter)
                     return True
-            _emit(i)
+            health_i = (mon.assemble(hvals_host[i])
+                        if mon is not None else None)
+            _emit(i, health=health_i)
+            if mon is not None:
+                from ..health import TrainingHealthError
+                try:
+                    mon.apply_policy(health_i, self.iter + i + 1)
+                except TrainingHealthError:
+                    # halt must leave the booster CONSISTENT at i+1 kept
+                    # iterations, exactly like the early-stop branch: the
+                    # scan already applied the whole chunk's score
+                    # updates, so roll the surplus back before raising
+                    kept = i + 1
+                    if kept < k:
+                        self._rollback_chunk(kept * C, kept * C, bag_state,
+                                             ff_states, score_before,
+                                             valid_before)
+                    else:
+                        for e, s in zip(self.valid_datasets, vscores_out):
+                            e["score"] = (np.asarray(s)
+                                          if self._host_inputs else s)
+                    self.iter += kept
+                    raise
         if keep_iters < k:
             self._rollback_chunk(keep_iters * C, keep_iters * C,
                                  bag_state, ff_states, score_before,
@@ -1051,6 +1208,23 @@ class GBDT:
                             valid_vals[i][j])
             if vals:
                 self._last_eval_values = vals
+        if self._health_monitor is not None:
+            # eval-divergence tracking (health_divergence_rounds consecutive
+            # worsening iterations flag an anomaly; both eval paths — host
+            # and in-chunk — land here every iteration)
+            mon = self._health_monitor
+            if train_vals is not None:
+                for metric, values in zip(self.training_metrics, train_vals):
+                    mon.observe_eval("training/" + metric.name,
+                                     float(values[-1]),
+                                     metric.is_bigger_better)
+            if valid_vals is not None:
+                for i, entry in enumerate(self.valid_datasets):
+                    for j, metric in enumerate(self.valid_metrics[i]):
+                        mon.observe_eval(
+                            entry["name"] + "/" + metric.name,
+                            float(valid_vals[i][j][-1]),
+                            metric.is_bigger_better)
         if eval_now and train_vals is not None:
             for metric, values in zip(self.training_metrics, train_vals):
                 log.info("Iteration:%d, %s : %s"
@@ -1331,14 +1505,18 @@ def make_chunk_body(*, grad_fn, obj_params, num_class: int, lrf, grow_fn,
                     base_mask=None, max_nodes: int = 1,
                     valid_bins=(), valid_mparams=(),
                     train_metric_fns=(), train_mparams=(),
-                    valid_metric_fns=()):
+                    valid_metric_fns=(), health_fn=None):
     """The per-iteration boosting body shared by the serial chunk program
     and the data-parallel shard_map chunk (parallel/learners.py):
     gradients → per-class grow → train-score update (+ valid-score replay
     and in-program metric evaluation when configured).  ``grow_fn`` carries
     the grower statics — and, for the data-parallel case, the psum
     hist/stat reducers; ``base_mask`` is the always-on row validity mask
-    (shard padding) and composes with the per-iteration bagging mask."""
+    (shard padding) and composes with the per-iteration bagging mask.
+    ``health_fn`` (health.make_health_fn) accumulates the per-iteration
+    training-health vector in-program — the fused chunk is the only place
+    those per-iteration values exist; the vector is pure extra reductions
+    over the existing arrays, never fed back into them."""
     F, N = bins.shape
     n_valid = len(valid_bins)
 
@@ -1378,7 +1556,9 @@ def make_chunk_body(*, grad_fn, obj_params, num_class: int, lrf, grow_fn,
             for f, p in zip(valid_metric_fns[v], valid_mparams[v]):
                 mv.append(f(p, sv))
         mvals = jnp.concatenate(mv) if mv else jnp.zeros((0,), jnp.float32)
-        return (score, tuple(vscores)), (stacked, mvals)
+        hvec = (health_fn(grad, hess, score) if health_fn is not None
+                else jnp.zeros((0,), jnp.float32))
+        return (score, tuple(vscores)), (stacked, mvals, hvec)
 
     return body
 
@@ -1392,13 +1572,15 @@ def _get_chunk_program(obj_key, grad_fn, num_class: int, lr: float,
                        leafwise_compact: bool = False,
                        has_bag: bool, has_ff: bool,
                        train_metric_fns: tuple = (),
-                       valid_metric_fns: tuple = ()):
+                       valid_metric_fns: tuple = (),
+                       health_fn=None):
     key = (obj_key, id(grad_fn), num_class, lr, grow_policy, num_leaves,
            num_bins_max, min_data_in_leaf, min_sum_hessian_in_leaf,
            max_depth, hist_chunk, hist_dtype, quant_rounding,
            leafwise_compact, has_bag, has_ff,
            tuple(id(f) for f in train_metric_fns),
-           tuple(tuple(id(f) for f in fns) for fns in valid_metric_fns))
+           tuple(tuple(id(f) for f in fns) for fns in valid_metric_fns),
+           id(health_fn) if health_fn is not None else None)
     prog = _CHUNK_PROGRAMS.get(key)
     if prog is not None:
         return prog
@@ -1435,10 +1617,10 @@ def _get_chunk_program(obj_key, grad_fn, num_class: int, lr: float,
             max_nodes=max_nodes, valid_bins=valid_bins,
             valid_mparams=valid_mparams,
             train_metric_fns=train_metric_fns, train_mparams=train_mparams,
-            valid_metric_fns=valid_metric_fns)
-        (score, vscores), (stacked, mvals) = jax.lax.scan(
+            valid_metric_fns=valid_metric_fns, health_fn=health_fn)
+        (score, vscores), (stacked, mvals, hvals) = jax.lax.scan(
             body, (score, tuple(valid_scores)), (row_masks, feat_masks))
-        return score, vscores, stacked, mvals
+        return score, vscores, stacked, mvals, hvals
 
     prog = jax.jit(chunk_fn)
     _CHUNK_PROGRAMS[key] = prog

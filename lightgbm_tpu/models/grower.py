@@ -373,7 +373,10 @@ def grow_tree_impl(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         def no_split(state: _GrowState) -> _GrowState:
             return state._replace(done=jnp.asarray(True))
 
-        return jax.lax.cond(should_split, do_split, no_split, state)
+        # profiler alignment (ISSUE 2): the whole split body is labeled in
+        # HLO metadata so profile_dir= traces group the per-split ops
+        with jax.named_scope("leafwise_split"):
+            return jax.lax.cond(should_split, do_split, no_split, state)
 
     count = L - 1 if loop_count is None else loop_count
     state = jax.lax.fori_loop(0, count, body, state)

@@ -139,8 +139,12 @@ def grow_tree_depthwise(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     if partition_bins is None:
         partition_bins = bins
 
-    # ---- root (BeforeTrain: serial_tree_learner.cpp:155-236)
-    hists = batch_hist(jnp.zeros((N,), i32), row_mask, 1)  # [1, F, B, 3]
+    # ---- root (BeforeTrain: serial_tree_learner.cpp:155-236).
+    # named_scope per level (ISSUE 2): profile_dir= Perfetto traces show
+    # the unrolled level structure ("level0/histogram", ...) instead of a
+    # flat op soup — unconditional, so it can't perturb program identity
+    with jax.named_scope("level0"):
+        hists = batch_hist(jnp.zeros((N,), i32), row_mask, 1)  # [1,F,B,3]
     if str(compute_dtype).startswith("int8"):
         # derive root stats from the root histogram: the quantized hist is
         # bit-identical across serial / data-parallel / multi-process (the
@@ -333,7 +337,9 @@ def grow_tree_depthwise(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         # reference's per-leaf index lists, data_partition.hpp) costs more
         # in cumsum/scatter/gather plumbing than the halved histogram pass
         # saves — see git history for the removed compaction path.
-        hist_small = batch_hist(par_of_row, sel, P, level=True, salt=d + 1)
+        with jax.named_scope("level%d" % (d + 1)):
+            hist_small = batch_hist(par_of_row, sel, P, level=True,
+                                    salt=d + 1)
         hist_large = hists - hist_small
         hsmall_slot = interleave(jnp.where(small_is_right[:, None, None, None],
                                            hist_large, hist_small),

@@ -434,7 +434,10 @@ def grow_tree_leafcompact_impl(bins, grad, hess, row_mask, feature_mask,
         def no_split(state: _CompactState) -> _CompactState:
             return state._replace(done=jnp.asarray(True))
 
-        return jax.lax.cond(should_split, do_split, no_split, state)
+        # profiler alignment (ISSUE 2): label the compacted split body so
+        # profile_dir= traces group its partition/histogram ops per split
+        with jax.named_scope("leafcompact_split"):
+            return jax.lax.cond(should_split, do_split, no_split, state)
 
     state = jax.lax.fori_loop(0, L - 1, body, state)
     return state if return_state else state.tree

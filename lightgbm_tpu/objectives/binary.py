@@ -76,13 +76,17 @@ class BinaryLogloss:
 
 
 def _binary_gradients(params, score):
-    sig = params["sigmoid"]
-    ls = params["label_sign"]
-    response = -2.0 * ls * sig / (1.0 + jnp.exp(2.0 * ls * sig * score))
-    abs_response = jnp.abs(response)
-    grad = response * params["label_weight"]
-    hess = abs_response * (2.0 * sig - abs_response) * params["label_weight"]
-    if params["weights"] is not None:
-        grad = grad * params["weights"]
-        hess = hess * params["weights"]
-    return grad, hess
+    # named_scope: profile_dir= traces label the gradient ops with the
+    # objective (matches the telemetry "gradient" phase; ISSUE 2)
+    with jax.named_scope("gradient_binary"):
+        sig = params["sigmoid"]
+        ls = params["label_sign"]
+        response = -2.0 * ls * sig / (1.0 + jnp.exp(2.0 * ls * sig * score))
+        abs_response = jnp.abs(response)
+        grad = response * params["label_weight"]
+        hess = (abs_response * (2.0 * sig - abs_response)
+                * params["label_weight"])
+        if params["weights"] is not None:
+            grad = grad * params["weights"]
+            hess = hess * params["weights"]
+        return grad, hess

@@ -55,10 +55,13 @@ class MulticlassLogloss:
 
 
 def _multiclass_gradients(params, score):
-    p = jax.nn.softmax(score.astype(jnp.float32), axis=0)  # [K, N]
-    grad = p - params["onehot"].T
-    hess = 2.0 * p * (1.0 - p)
-    if params["weights"] is not None:
-        grad = grad * params["weights"][None, :]
-        hess = hess * params["weights"][None, :]
-    return grad, hess
+    # named_scope: profile_dir= traces label the gradient ops with the
+    # objective (matches the telemetry "gradient" phase; ISSUE 2)
+    with jax.named_scope("gradient_multiclass"):
+        p = jax.nn.softmax(score.astype(jnp.float32), axis=0)  # [K, N]
+        grad = p - params["onehot"].T
+        hess = 2.0 * p * (1.0 - p)
+        if params["weights"] is not None:
+            grad = grad * params["weights"][None, :]
+            hess = hess * params["weights"][None, :]
+        return grad, hess

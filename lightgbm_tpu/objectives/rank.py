@@ -155,19 +155,22 @@ _RANK_FNS: dict = {}
 
 
 def _rank_gradients(params, score, *, block: int):
-    lambdas, hessians = _lambdarank_grads(
-        score.astype(jnp.float32), params["doc_index"], params["valid"],
-        params["labels"], params["inv_max_dcg"], params["discount"],
-        params["gains"], params["sigmoid"], block)
-    if params["weights"] is not None:
-        w = params["weights"]
-        if w.shape[0] < lambdas.shape[0]:
-            # single-process DP pads rows at the tail; padded rows carry
-            # zero lambdas, so zero-padding the weights is exact
-            w = jnp.pad(w, (0, lambdas.shape[0] - w.shape[0]))
-        lambdas = lambdas * w
-        hessians = hessians * w
-    return lambdas, hessians
+    # named_scope: profile_dir= traces label the lambda ops with the
+    # objective (matches the telemetry "gradient" phase; ISSUE 2)
+    with jax.named_scope("gradient_lambdarank"):
+        lambdas, hessians = _lambdarank_grads(
+            score.astype(jnp.float32), params["doc_index"], params["valid"],
+            params["labels"], params["inv_max_dcg"], params["discount"],
+            params["gains"], params["sigmoid"], block)
+        if params["weights"] is not None:
+            w = params["weights"]
+            if w.shape[0] < lambdas.shape[0]:
+                # single-process DP pads rows at the tail; padded rows
+                # carry zero lambdas, so zero-padding the weights is exact
+                w = jnp.pad(w, (0, lambdas.shape[0] - w.shape[0]))
+            lambdas = lambdas * w
+            hessians = hessians * w
+        return lambdas, hessians
 
 
 @functools.partial(jax.jit, static_argnames=("block",))

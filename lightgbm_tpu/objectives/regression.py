@@ -46,9 +46,12 @@ class RegressionL2Loss:
 
 
 def _regression_gradients(params, score):
-    grad = score.astype(jnp.float32) - params["label"]
-    hess = jnp.ones_like(grad)
-    if params["weights"] is not None:
-        grad = grad * params["weights"]
-        hess = hess * params["weights"]
-    return grad, hess
+    # named_scope: profile_dir= traces label the gradient ops with the
+    # objective (matches the telemetry "gradient" phase; ISSUE 2)
+    with jax.named_scope("gradient_regression"):
+        grad = score.astype(jnp.float32) - params["label"]
+        hess = jnp.ones_like(grad)
+        if params["weights"] is not None:
+            grad = grad * params["weights"]
+            hess = hess * params["weights"]
+        return grad, hess

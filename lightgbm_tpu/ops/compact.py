@@ -157,6 +157,17 @@ def partition_segment(seg, mask3, delta, cnt, plcnt, *, block: int = BLOCK,
 
 def _partition_segment_impl(seg, mask3, delta, cnt, plcnt, *, block,
                             use_pallas, interpret):
+    # unconditional named_scope: profile_dir= traces label the kernel /
+    # oracle ops "partition", matching the telemetry span and JSONL phase
+    # key whether or not telemetry is armed (ISSUE 2 profiler alignment)
+    with jax.named_scope("partition"):
+        return _partition_segment_scoped(
+            seg, mask3, delta, cnt, plcnt, block=block,
+            use_pallas=use_pallas, interpret=interpret)
+
+
+def _partition_segment_scoped(seg, mask3, delta, cnt, plcnt, *, block,
+                              use_pallas, interpret):
     R, W = seg.shape
     assert W % block == 0, (W, block)
     lane = jnp.arange(W, dtype=jnp.int32)

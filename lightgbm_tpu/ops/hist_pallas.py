@@ -252,6 +252,37 @@ def quantize_values(grad, hess, col_ok, rng_bits=None, axis_name=None,
     return vals, jnp.stack([gs, hs, jnp.float32(1.0)])
 
 
+def quant_saturation_count(grad, hess, axis_name=None):
+    """Health gauge: how many grad/hess entries quantize to the ±127
+    ceiling under quantize_values' per-pass max scale (|x| > 126.5·s with
+    s = max|x|/127).  The scale construction pins the max row at 127 by
+    design, so a handful of saturated rows is normal; a LARGE count means
+    the magnitude distribution has collapsed onto the ceiling — iteration
+    0's uniform hessians are the canonical case, and the precondition for
+    the int32 accumulator wraparound models/gbdt.check_int8_row_capacity
+    bounds.  Kept next to quantize_values so the two can never drift.
+
+    Uses the finite global max per channel (the health monitor evaluates
+    once per iteration over ALL rows).  Histogram passes quantize with
+    per-pass MASKED scales ≤ this global max, so a pass whose local max
+    sits below the global one saturates MORE of its entries than the
+    gauge counts — read the gauge as a floor, not a ceiling: nonzero
+    means at-least-this-much concentration at the representable limit.
+    ``axis_name``: pmax the scale across shards before counting, psum the
+    count — every shard reports the identical global gauge."""
+    f32 = jnp.float32
+    total = jnp.zeros((), f32)
+    for x in (grad, hess):
+        ax = jnp.where(jnp.isfinite(x), jnp.abs(x), 0.0)
+        m = jnp.max(ax)
+        if axis_name is not None:
+            m = jax.lax.pmax(m, axis_name)
+        sat = jnp.sum((ax * 127.0 > m * 126.5).astype(f32))
+        total = total + (jax.lax.psum(sat, axis_name)
+                         if axis_name is not None else sat)
+    return total
+
+
 def _grouped(fn, bins, grad, hess, col_id, col_ok, num_cols, B, *,
              group_width=42, **kw):
     """Split levels wider than ``group_width`` columns into balanced
@@ -284,7 +315,9 @@ def hist_pallas_leafbatch(bins, grad, hess, col_id, col_ok, num_cols: int,
     43-64 use a 192-lane operand = 1.5 tiles, cheaper than two full
     passes over the data); wider levels split into 64-column groups."""
     from .. import telemetry
-    with telemetry.span("histogram") as sp:
+    # named_scope unconditionally (the span is a no-op with telemetry
+    # off): profile_dir= traces label the kernel "histogram" either way
+    with jax.named_scope("histogram"), telemetry.span("histogram") as sp:
         return sp.fence(_grouped(
             _hist_pallas_one, bins, grad, hess, col_id, col_ok,
             num_cols, num_bins_max, group_width=64, chunk=chunk,
@@ -356,13 +389,14 @@ def hist_pallas_float_leafbatch(bins, grad, hess, col_id, col_ok,
     """
     if precision == "f32":
         precision = "f32x1" if num_cols <= 38 else "f32x2"
-    if precision == "f32x1":
-        return _grouped(_hist_float_one, bins, grad, hess, col_id,
-                        col_ok, num_cols, num_bins_max, group_width=38,
-                        chunk=chunk, precision=precision)
-    return _grouped(_hist_float_one, bins, grad, hess, col_id, col_ok,
-                    num_cols, num_bins_max, group_width=64, chunk=chunk,
-                    precision=precision)
+    with jax.named_scope("histogram"):
+        if precision == "f32x1":
+            return _grouped(_hist_float_one, bins, grad, hess, col_id,
+                            col_ok, num_cols, num_bins_max, group_width=38,
+                            chunk=chunk, precision=precision)
+        return _grouped(_hist_float_one, bins, grad, hess, col_id, col_ok,
+                        num_cols, num_bins_max, group_width=64, chunk=chunk,
+                        precision=precision)
 
 
 def _hist_float_one(bins, grad, hess, col_id, col_ok, num_cols, B, *,
@@ -421,7 +455,7 @@ def hist_quant_xla(bins, grad, hess, col_id, col_ok, num_cols: int,
     fallback on non-TPU backends."""
     from .. import telemetry
     telemetry.count("hist/xla_int_kernel")
-    with telemetry.span("histogram") as sp:
+    with jax.named_scope("histogram"), telemetry.span("histogram") as sp:
         return sp.fence(_grouped(
             _hist_quant_xla_one, bins, grad, hess, col_id, col_ok,
             num_cols, num_bins_max, chunk=chunk, rng_bits=rng_bits,

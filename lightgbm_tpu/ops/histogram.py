@@ -83,6 +83,17 @@ def histogram_matmul(bins: jax.Array, grad: jax.Array, hess: jax.Array,
 
 def _histogram_matmul_impl(bins, grad, hess, mask, num_bins_max, chunk,
                            compute_dtype) -> jax.Array:
+    # named_scope is UNCONDITIONAL (unlike the telemetry span wrapping the
+    # caller): a profile_dir= Perfetto trace labels these ops "histogram"
+    # whether or not telemetry is armed, and the scope is always present
+    # so telemetry on/off cannot change the traced program's identity
+    with jax.named_scope("histogram"):
+        return _histogram_matmul_scoped(bins, grad, hess, mask,
+                                        num_bins_max, chunk, compute_dtype)
+
+
+def _histogram_matmul_scoped(bins, grad, hess, mask, num_bins_max, chunk,
+                             compute_dtype) -> jax.Array:
     F, N = bins.shape
     B = num_bins_max
     # bound the transient one-hot working set ([F, chunk, B] floats) by a
@@ -206,7 +217,7 @@ def histogram_leafbatch(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                 bins, grad, hess, col_id, col_ok, num_cols, num_bins_max,
                 precision=precision))
     telemetry.count("hist/xla_einsum")
-    with telemetry.span("histogram") as sp:
+    with jax.named_scope("histogram"), telemetry.span("histogram") as sp:
         return sp.fence(_leafbatch_einsum(
             bins, grad, hess, col_id, col_ok, num_cols, num_bins_max,
             chunk=chunk, compute_dtype=compute_dtype))

@@ -71,6 +71,18 @@ def find_best_split(hist: jax.Array, sum_grad: jax.Array, sum_hess: jax.Array,
 def _find_best_split_impl(hist, sum_grad, sum_hess, num_data, num_bins,
                           feature_mask, min_data_in_leaf,
                           min_sum_hessian_in_leaf) -> SplitResult:
+    # unconditional named_scope: profile_dir= traces label these ops
+    # "split_find" — the same key as the telemetry span/JSONL records —
+    # with or without telemetry armed (ISSUE 2 profiler alignment)
+    with jax.named_scope("split_find"):
+        return _find_best_split_scoped(
+            hist, sum_grad, sum_hess, num_data, num_bins, feature_mask,
+            min_data_in_leaf, min_sum_hessian_in_leaf)
+
+
+def _find_best_split_scoped(hist, sum_grad, sum_hess, num_data, num_bins,
+                            feature_mask, min_data_in_leaf,
+                            min_sum_hessian_in_leaf) -> SplitResult:
     F, B, _ = hist.shape
     eps = jnp.float32(K_EPSILON)
 

@@ -39,9 +39,13 @@ from .mesh import DATA_AXIS, FEATURE_AXIS, get_mesh
 
 
 def aggregate_telemetry() -> None:
-    """Fold every host's kernel-route counters into the leader's registry
-    (``allhosts/<name>`` keys) so the leader's JSONL summary speaks for
-    the whole job, not just process 0.
+    """Fold every host's kernel-route counters — and its peak-memory
+    watermark — into the leader's registry (``allhosts/<name>`` counter
+    keys; ``allhosts_peak_bytes_in_use`` in the memory block) so the
+    leader's JSONL summary speaks for the whole job, not just process 0.
+    Health anomaly totals ride the counters (``health/*``,
+    health.HealthMonitor.apply_policy mirrors every anomaly there), so
+    they aggregate with no extra machinery.
 
     COLLECTIVE: every multi-process run must call it on EVERY process
     (gbdt.run_training does, at end of training) — including processes
@@ -49,10 +53,11 @@ def aggregate_telemetry() -> None:
     participation on local telemetry state would hang the enabled hosts
     in the allgather.  Hosts may also disagree on which counters exist (a
     per-process LGBM_TPU_NO_PALLAS trip, a warm persistent compile cache
-    skipping recompiles), so each host ships its counters as a JSON blob
-    in a fixed-size byte buffer and the sum is aligned BY NAME — a
+    skipping recompiles), so each host ships its payload as a JSON blob
+    in a fixed-size byte buffer and counters are summed BY NAME — a
     fixed-order value allgather would silently add other hosts' values to
     the wrong keys whenever key sets differ with equal cardinality.
+    Memory peaks reduce by max (a watermark, not a flow).
     Single-process runs return immediately."""
     if jax.process_count() <= 1:
         return
@@ -61,10 +66,13 @@ def aggregate_telemetry() -> None:
         import json
         from jax.experimental import multihost_utils
         items = sorted(telemetry.counters().items())
-        raw = json.dumps(dict(items)).encode()
+        payload = {"c": dict(items),
+                   "mem_peak": telemetry.mem_peak_bytes()}
+        raw = json.dumps(payload).encode()
         while len(raw) > blob_cap and items:  # pragma: no cover - 100s of keys
             items = items[:len(items) // 2]
-            raw = json.dumps(dict(items)).encode()
+            payload["c"] = dict(items)
+            raw = json.dumps(payload).encode()
             log.warning("telemetry counters exceed the %d-byte aggregation "
                         "buffer; cross-host sums cover only this host's "
                         "first %d keys" % (blob_cap, len(items)))
@@ -72,12 +80,16 @@ def aggregate_telemetry() -> None:
         buf[:len(raw)] = np.frombuffer(raw, np.uint8)
         gathered = np.asarray(multihost_utils.process_allgather(buf))
         totals: dict = {}
+        peak = 0
         for row in gathered:
-            payload = bytes(row).rstrip(b"\x00").decode()
-            for k, v in json.loads(payload or "{}").items():
+            blob = json.loads(bytes(row).rstrip(b"\x00").decode() or "{}")
+            for k, v in blob.get("c", {}).items():
                 totals[k] = totals.get(k, 0) + int(v)
+            peak = max(peak, int(blob.get("mem_peak", 0)))
         if telemetry.enabled():
             telemetry.merge_host_counters(totals)
+            if peak:
+                telemetry.merge_host_memory(peak)
     except Exception as e:  # pragma: no cover - collective failure
         log.warning("telemetry cross-host aggregation failed: %s" % e)
 
@@ -300,7 +312,8 @@ class DataParallelLearner(_ParallelLearnerBase):
                       has_bag: bool, has_ff: bool,
                       train_metric_fns=(), valid_metric_fns=(),
                       n_valid: int = 0, shard_layout=None,
-                      needs_global_score: bool = False):
+                      needs_global_score: bool = False,
+                      health: bool = False):
         """Fused k-iteration training program under shard_map: the whole
         gradients → grow(psum'd histograms) → score-update scan runs sharded
         over the mesh, one dispatch per chunk (the data-parallel analog of
@@ -336,10 +349,18 @@ class DataParallelLearner(_ParallelLearnerBase):
                        and self._schedule() == "psum"
                        and self._leafwise_compact_enabled())
         num_features = gbdt.num_features
+        # in-program health vector: local reductions + psum/pmax over the
+        # data axis, so every shard carries the identical global vector
+        # (lightgbm_tpu/health.py; the [8] extra output rides replicated)
+        health_fn = None
+        if health:
+            from ..health import make_health_fn
+            health_fn = make_health_fn(
+                self.tree_config.hist_dtype == "int8", DATA_AXIS)
         key = (obj_key, id(grad_fn), num_shards, num_class, lr, depthwise,
                tuple(sorted(kwargs.items())), has_bag, has_ff, n_true,
                shard_layout, needs_global_score, use_scatter, use_compact,
-               num_features,
+               num_features, bool(health),
                tuple(id(f) for f in train_metric_fns),
                tuple(tuple(id(f) for f in fns) for fns in valid_metric_fns))
         prog = _DP_CHUNK_PROGRAMS.get(key)
@@ -429,11 +450,11 @@ class DataParallelLearner(_ParallelLearnerBase):
                 max_nodes=max_nodes, valid_bins=valid_bins,
                 valid_mparams=valid_mparams,
                 train_metric_fns=train_fns, train_mparams=train_mparams,
-                valid_metric_fns=valid_metric_fns)
-            (score, vscores), (stacked, mvals) = jax.lax.scan(
+                valid_metric_fns=valid_metric_fns, health_fn=health_fn)
+            (score, vscores), (stacked, mvals, hvals) = jax.lax.scan(
                 body, (score, tuple(valid_scores)),
                 (row_masks, feat_masks))
-            return score, vscores, stacked, mvals
+            return score, vscores, stacked, mvals, hvals
 
         def param_spec(leaf):
             # row-aligned arrays ride the data axis; scalars are replicated;
@@ -454,7 +475,7 @@ class DataParallelLearner(_ParallelLearnerBase):
                       P(), P(), P(), P()),
             out_specs=(P(None, DATA_AXIS),
                        tuple(P() for _ in range(n_valid)),
-                       _tree_out_specs(None), P())))
+                       _tree_out_specs(None), P(), P())))
         _DP_CHUNK_PROGRAMS[key] = prog
         return prog, num_shards
 
@@ -718,10 +739,12 @@ class FeatureParallelLearner(_ParallelLearnerBase):
     def chunk_program(self, gbdt, obj_key, grad_fn, obj_params,
                       has_bag: bool, has_ff: bool,
                       train_metric_fns=(), valid_metric_fns=(),
-                      n_valid: int = 0):
+                      n_valid: int = 0, health: bool = False):
         """Fused k-iteration feature-parallel chunk (same contract as the
         data-parallel chunk_program / serial chunk program).  Rows are
-        replicated, so metric evaluation needs no gathering."""
+        replicated, so metric evaluation needs no gathering — and neither
+        does the health vector (every shard computes the identical
+        full-row reductions)."""
         mesh = get_mesh(self.config.network_config.num_machines,
                         FEATURE_AXIS, getattr(self.config, 'device_type', ''))
         num_shards = mesh.shape[FEATURE_AXIS]
@@ -730,9 +753,14 @@ class FeatureParallelLearner(_ParallelLearnerBase):
         kwargs = self._grow_kwargs(gbdt)
         grow = grow_tree_depthwise if self._depthwise else grow_tree_impl
         max_nodes = max(_effective_num_leaves(self.tree_config) - 1, 1)
+        health_fn = None
+        if health:
+            from ..health import make_health_fn
+            health_fn = make_health_fn(
+                self.tree_config.hist_dtype == "int8", None)
         key = (obj_key, id(grad_fn), num_shards, num_class, lr,
                self._depthwise, tuple(sorted(kwargs.items())), has_bag,
-               has_ff,
+               has_ff, bool(health),
                tuple(id(f) for f in train_metric_fns),
                tuple(tuple(id(f) for f in fns) for fns in valid_metric_fns))
         prog = _FP_CHUNK_PROGRAMS.get(key)
@@ -754,17 +782,17 @@ class FeatureParallelLearner(_ParallelLearnerBase):
                 valid_bins=valid_bins, valid_mparams=valid_mparams,
                 train_metric_fns=train_metric_fns,
                 train_mparams=train_mparams,
-                valid_metric_fns=valid_metric_fns)
-            (score, vscores), (stacked, mvals) = jax.lax.scan(
+                valid_metric_fns=valid_metric_fns, health_fn=health_fn)
+            (score, vscores), (stacked, mvals, hvals) = jax.lax.scan(
                 body, (score, tuple(valid_scores)),
                 (row_masks, feat_masks))
-            return score, vscores, stacked, mvals
+            return score, vscores, stacked, mvals, hvals
 
         prog = jax.jit(shard_map(
             shard_chunk, mesh=mesh,
             in_specs=(P(),) * 12,
             out_specs=(P(), tuple(P() for _ in range(n_valid)),
-                       _tree_out_specs(None), P())))
+                       _tree_out_specs(None), P(), P())))
         _FP_CHUNK_PROGRAMS[key] = prog
         return prog, num_shards
 

@@ -49,10 +49,32 @@ the sink (decided lazily at first write, after jax.distributed init);
 ``parallel.learners.aggregate_telemetry`` folds every host's counters into
 the leader before the final summary record.  Library users who want the
 data without a file call ``snapshot()``.
+
+ISSUE 2 additions — the device-side observability triad:
+
+3. **Memory gauges** (``set_memory(True)`` / ``enable(memory=True)``, the
+   ``memory_stats=`` config option): spans additionally sample the device
+   allocator (``device.memory_stats()``; host-RSS fallback on backends
+   that return None, e.g. CPU) at their boundaries, recording per-phase
+   byte deltas and a process-peak ``bytes_in_use`` watermark.  Iteration
+   records gain a ``memory`` block (``take_memory_record``), the summary
+   and ``snapshot()`` a cumulative one, and ``set_residency`` files the
+   one-shot dataset-residency report (bin matrix / metadata / histogram
+   scratch) at train start.  Sampling is a host-side stats read — it
+   never dispatches device work.
+
+4. **Profiler alignment**: every span body runs under
+   ``jax.named_scope(name)`` + ``jax.profiler.TraceAnnotation(name)``, so
+   a Perfetto trace captured via ``profile_dir=`` carries the SAME phase
+   names as the JSONL records — device rows (HLO op metadata) and host
+   timeline rows line up with ``phase_times`` keys.  Health events (NaN
+   counts, saturation, divergence — lightgbm_tpu/health.py) ride the
+   iteration records as a ``health`` block via ``emit_iteration``.
 """
 from __future__ import annotations
 
 import json
+import os
 import time
 from typing import Dict, List, Optional
 
@@ -81,6 +103,22 @@ _mark_trace: Dict[str, float] = {}
 # last outcome per host-evaluated routing rule (count_route dedup)
 _route_state: Dict[str, str] = {}
 
+# memory gauges (ISSUE 2): armed separately from the base registry so hot
+# spans pay the allocator-stats read only when asked for
+_memory = False
+_mem_device = None            # cached jax device handle
+_mem_source: Optional[str] = None
+_mem_peak = 0                 # this run's bytes_in_use watermark
+# the allocator's LIFETIME peak at the first post-reset sample: the device
+# stat is monotonic since allocator creation, so a fresh run must baseline
+# it or it would report the previous run's (possibly much larger) peak
+_mem_dev_peak_base: Optional[int] = None
+_mem_phase_delta: Dict[str, int] = {}   # cumulative per-phase byte deltas
+_mem_phase_peak: Dict[str, int] = {}    # per-phase bytes_in_use watermark
+_mark_mem: Dict[str, int] = {}          # per-iteration delta marks
+_residency: Optional[dict] = None       # one-shot dataset-residency report
+_allhosts_mem_peak: Optional[int] = None
+
 _compile_listener_installed = False
 
 
@@ -90,16 +128,21 @@ def enabled() -> bool:
     return _enabled
 
 
-def enable(jsonl_path: Optional[str] = None, fence: bool = False) -> None:
+def enable(jsonl_path: Optional[str] = None, fence: bool = False,
+           memory: Optional[bool] = None) -> None:
     """Arm the registry (and optionally a JSONL sink at ``jsonl_path``).
 
     Idempotent; a second call can attach a sink or toggle fence mode.  The
     sink file is opened lazily at first record — after jax.distributed
     initialization — so only process 0 writes in multi-process runs.
+    ``memory`` arms/disarms the span-boundary memory gauges (None leaves
+    the current mode unchanged).
     """
-    global _enabled, _fence, _sink_path, _sink_error, _sink_file
+    global _enabled, _fence, _sink_path, _sink_error, _sink_file, _memory
     _enabled = True
     _fence = bool(fence)
+    if memory is not None:
+        _memory = bool(memory)
     if jsonl_path:
         if _sink_file is not None and jsonl_path != _sink_path:
             # re-targeting an open sink: close the old handle or records
@@ -116,9 +159,10 @@ def enable(jsonl_path: Optional[str] = None, fence: bool = False) -> None:
 
 def disable() -> None:
     """Stop recording and close the sink (pending data is flushed)."""
-    global _enabled, _fence, _sink_file, _sink_path
+    global _enabled, _fence, _sink_file, _sink_path, _memory
     _enabled = False
     _fence = False
+    _memory = False
     if _sink_file is not None:
         try:
             _sink_file.close()
@@ -129,7 +173,9 @@ def disable() -> None:
 
 
 def reset() -> None:
-    """Zero all counters/timers (sink and enabled state are untouched)."""
+    """Zero all counters/timers/gauges (sink and enabled state are
+    untouched)."""
+    global _mem_peak, _residency, _allhosts_mem_peak, _mem_dev_peak_base
     _counters.clear()
     _phase_times.clear()
     _phase_counts.clear()
@@ -137,6 +183,13 @@ def reset() -> None:
     _mark_phase.clear()
     _mark_trace.clear()
     _route_state.clear()
+    _mem_phase_delta.clear()
+    _mem_phase_peak.clear()
+    _mark_mem.clear()
+    _mem_peak = 0
+    _mem_dev_peak_base = None    # re-baselined at the next sample
+    _residency = None
+    _allhosts_mem_peak = None
     del _span_stack[:]
 
 
@@ -149,10 +202,135 @@ def fence_enabled() -> bool:
     return _fence
 
 
+def set_memory(on: bool) -> None:
+    """Arm/disarm the span-boundary memory gauges."""
+    global _memory
+    _memory = bool(on)
+
+
+def memory_enabled() -> bool:
+    return _memory
+
+
 def sink_active() -> bool:
     """True when iteration records have somewhere to go (a sink path is
     configured) — the boosting loop's cheap guard around record assembly."""
     return _enabled and _sink_path is not None
+
+
+def sink_open() -> bool:
+    """True when a sink is configured or a file handle is still open —
+    the test-suite leak guard's check (tests/conftest.py)."""
+    return _sink_file is not None or (_enabled and _sink_path is not None)
+
+
+# ---------------------------------------------------------- memory sampling
+
+def _mem_sample() -> int:
+    """Current memory footprint in bytes, updating the process watermark.
+
+    Prefers the device allocator (``device.memory_stats()["bytes_in_use"]``
+    — real HBM occupancy on TPU/GPU, including its own peak watermark);
+    backends that return None (CPU) fall back to the process RSS from
+    /proc/self/statm, so CPU runs still carry a meaningful gauge.  A pure
+    stats read: never allocates or dispatches device work."""
+    global _mem_device, _mem_source, _mem_peak, _mem_dev_peak_base
+    try:
+        if _mem_device is None:
+            import jax
+            _mem_device = jax.local_devices()[0]
+        ms = _mem_device.memory_stats()
+        if ms and "bytes_in_use" in ms:
+            b = int(ms["bytes_in_use"])
+            # the allocator's peak stat is monotonic over the PROCESS: only
+            # growth past the post-reset baseline belongs to this run (it
+            # catches transient spikes between our samples); a larger
+            # previous run's peak must not leak into this run's watermark
+            dev_peak = int(ms.get("peak_bytes_in_use", 0))
+            if _mem_dev_peak_base is None:
+                _mem_dev_peak_base = dev_peak
+            if dev_peak > _mem_dev_peak_base:
+                _mem_peak = max(_mem_peak, dev_peak)
+            _mem_peak = max(_mem_peak, b)
+            _mem_source = "device"
+            return b
+    except Exception:
+        pass
+    try:
+        with open("/proc/self/statm") as f:
+            b = int(f.read().split()[1]) * (os.sysconf("SC_PAGE_SIZE")
+                                            if hasattr(os, "sysconf")
+                                            else 4096)
+        _mem_peak = max(_mem_peak, b)
+        _mem_source = "host_rss"
+        return b
+    except Exception:
+        if _mem_source is None:
+            _mem_source = "unavailable"
+        return 0
+
+
+def take_memory_record() -> Optional[dict]:
+    """Per-iteration ``memory`` block: current and peak bytes plus the
+    per-phase byte deltas accumulated since the previous call (re-marks,
+    mirroring take_phase_deltas).  None while memory gauges are off."""
+    if not _memory:
+        return None
+    b = _mem_sample()
+    deltas = {k: v - _mark_mem.get(k, 0)
+              for k, v in _mem_phase_delta.items()
+              if v - _mark_mem.get(k, 0) != 0}
+    _mark_mem.clear()
+    _mark_mem.update(_mem_phase_delta)
+    rec = {"bytes_in_use": int(b), "peak_bytes_in_use": int(_mem_peak),
+           "source": _mem_source or "unavailable"}
+    if deltas:
+        rec["phase_delta_bytes"] = {k: int(v)
+                                    for k, v in sorted(deltas.items())}
+    return rec
+
+
+def memory_snapshot() -> Optional[dict]:
+    """Cumulative memory block (summary record / ``snapshot()``): peak
+    watermark, cumulative per-phase deltas and per-phase peaks, the
+    dataset-residency report, and the cross-host peak when aggregated."""
+    if not (_memory or _mem_phase_delta or _residency is not None):
+        return None
+    out = {"bytes_in_use": int(_mem_sample()) if _memory else 0,
+           "peak_bytes_in_use": int(_mem_peak),
+           "source": _mem_source or "unavailable"}
+    if _mem_phase_delta:
+        out["phase_delta_bytes"] = {k: int(v) for k, v
+                                    in sorted(_mem_phase_delta.items())}
+        out["phase_peak_bytes"] = {k: int(v) for k, v
+                                   in sorted(_mem_phase_peak.items())}
+    if _residency is not None:
+        out["residency"] = _residency
+    if _allhosts_mem_peak is not None:
+        out["allhosts_peak_bytes_in_use"] = int(_allhosts_mem_peak)
+    return out
+
+
+def mem_peak_bytes() -> int:
+    return int(_mem_peak)
+
+
+def merge_host_memory(peak: int) -> None:
+    """Install the cross-host peak-bytes maximum (parallel.learners.
+    aggregate_telemetry) on this process."""
+    global _allhosts_mem_peak
+    _allhosts_mem_peak = int(peak)
+
+
+def set_residency(report: dict) -> None:
+    """File the one-shot dataset-residency report (bin matrix / metadata /
+    histogram scratch footprint, computed at train start by gbdt.init): it
+    rides ``memory_snapshot()`` and is written to the sink immediately as
+    a standalone ``{"residency": ...}`` record."""
+    global _residency
+    _residency = dict(report)
+    if sink_active():
+        write_record({"residency": _residency})
 
 
 # ------------------------------------------------------------------- spans
@@ -185,17 +363,46 @@ def _tracing() -> bool:
 class Span:
     """Context-managed phase timer.  ``fence(x)`` hands the span a value to
     ``jax.block_until_ready`` at exit when fence mode is on (execution-time
-    spans only; trace-time spans never block)."""
-    __slots__ = ("name", "_t0", "_fence_val", "_is_trace")
+    spans only; trace-time spans never block).
+
+    Profiler alignment (ISSUE 2): the span body runs under
+    ``jax.named_scope(name)`` (ops traced inside carry the phase name in
+    HLO metadata → Perfetto device rows) and
+    ``jax.profiler.TraceAnnotation(name)`` (a host-timeline trace event),
+    so ``profile_dir=`` traces line up with the JSONL phase keys.  With
+    memory gauges armed, the span also samples the allocator at its
+    boundaries (per-phase byte delta + watermark)."""
+    __slots__ = ("name", "_t0", "_fence_val", "_is_trace", "_scope",
+                 "_ann", "_mem0")
 
     def __init__(self, name: str):
         self.name = name
         self._fence_val = None
         self._is_trace = False
         self._t0 = 0.0
+        self._scope = None
+        self._ann = None
+        self._mem0 = None
 
     def __enter__(self):
         self._is_trace = _tracing()
+        # two independent try blocks: if the annotation fails AFTER the
+        # named scope entered, the scope must still be tracked (and later
+        # exited) or the global name stack would grow one entry per span
+        try:
+            import jax
+            self._scope = jax.named_scope(self.name)
+            self._scope.__enter__()
+        except Exception:
+            self._scope = None
+        try:
+            import jax
+            self._ann = jax.profiler.TraceAnnotation(self.name)
+            self._ann.__enter__()
+        except Exception:
+            self._ann = None
+        if _memory and not self._is_trace:
+            self._mem0 = _mem_sample()
         _span_stack.append(self.name)
         self._t0 = time.perf_counter()
         return self
@@ -214,6 +421,25 @@ class Span:
                 pass
         dt = time.perf_counter() - self._t0
         self._fence_val = None
+        if self._ann is not None:
+            try:
+                self._ann.__exit__(exc_type, exc, tb)
+            except Exception:
+                pass
+            self._ann = None
+        if self._scope is not None:
+            try:
+                self._scope.__exit__(exc_type, exc, tb)
+            except Exception:
+                pass
+            self._scope = None
+        if self._mem0 is not None:
+            b1 = _mem_sample()
+            _mem_phase_delta[self.name] = (
+                _mem_phase_delta.get(self.name, 0) + (b1 - self._mem0))
+            _mem_phase_peak[self.name] = max(
+                _mem_phase_peak.get(self.name, 0), b1, self._mem0)
+            self._mem0 = None
         if _span_stack and _span_stack[-1] == self.name:
             _span_stack.pop()
         if self._is_trace:
@@ -296,12 +522,16 @@ def _install_compile_listener() -> None:
 
 def snapshot() -> dict:
     """Cumulative registry state for library users (no sink required)."""
-    return {
+    out = {
         "phase_times": dict(_phase_times),
         "phase_counts": dict(_phase_counts),
         "trace_times": dict(_trace_times),
         "counters": dict(_counters),
     }
+    mem = memory_snapshot()
+    if mem is not None:
+        out["memory"] = mem
+    return out
 
 
 def take_phase_deltas() -> "tuple[Dict[str, float], Dict[str, float]]":
@@ -376,9 +606,14 @@ def write_record(record: dict) -> None:
 def emit_iteration(iteration: int, phase_times: Dict[str, float],
                    trace_times: Optional[Dict[str, float]] = None,
                    eval_metrics: Optional[dict] = None,
+                   health: Optional[dict] = None,
+                   memory: Optional[dict] = None,
                    extra: Optional[dict] = None) -> dict:
     """Build and write one per-iteration record.  Canonical phase keys are
-    always present; counters ride cumulatively.  Returns the record."""
+    always present; counters ride cumulatively.  ``health`` is the
+    iteration's training-health block (lightgbm_tpu/health.py),
+    ``memory`` the per-iteration gauge block (take_memory_record).
+    Returns the record."""
     pt = {k: 0.0 for k in CANONICAL_PHASES}
     pt.update(phase_times)
     record = {
@@ -389,6 +624,10 @@ def emit_iteration(iteration: int, phase_times: Dict[str, float],
     }
     if trace_times:
         record["trace_times"] = _round_times(trace_times)
+    if health is not None:
+        record["health"] = health
+    if memory is not None:
+        record["memory"] = memory
     if extra:
         record.update(extra)
     write_record(record)
@@ -396,8 +635,9 @@ def emit_iteration(iteration: int, phase_times: Dict[str, float],
 
 
 def emit_summary(extra: Optional[dict] = None) -> dict:
-    """Write the end-of-run totals record (cumulative phase/trace times and
-    counters — after cross-host aggregation in multi-process runs)."""
+    """Write the end-of-run totals record (cumulative phase/trace times,
+    counters and memory gauges — after cross-host aggregation in
+    multi-process runs)."""
     record = {
         "summary": True,
         "phase_times": _round_times(_phase_times),
@@ -405,6 +645,9 @@ def emit_summary(extra: Optional[dict] = None) -> dict:
         "trace_times": _round_times(_trace_times),
         "counters": dict(sorted(_counters.items())),
     }
+    mem = memory_snapshot()
+    if mem is not None:
+        record["memory"] = mem
     if extra:
         record.update(extra)
     write_record(record)

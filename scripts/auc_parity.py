@@ -97,7 +97,8 @@ def main() -> int:
     booster = GBDT()
     booster.init(cfg.boosting_config, ds,
                  create_objective(cfg.objective_type, cfg.objective_config))
-    t0 = time.time()
+    # perf_counter: monotonic (an NTP step would corrupt the duration)
+    t0 = time.perf_counter()
     if args.grow_policy == "leafwise":
         # leaf-wise runs per-iteration: a fused chunk is ONE dispatch of
         # k x 254 histogram passes and crosses the environment's ~60 s
@@ -117,7 +118,7 @@ def main() -> int:
             booster.train_chunk(k)
             done += k
     jax.block_until_ready(booster.score)
-    t_ours = time.time() - t0
+    t_ours = time.perf_counter() - t0
     ours_scores = booster.predict_raw(xte)
     ours_auc = auc_manual(yte, ours_scores)
     print(f"ours[{args.grow_policy}/{args.hist_dtype}/"
@@ -148,10 +149,10 @@ def main() -> int:
                      ["metric_freq=1000", "is_training_metric=false",
                       f"output_model={wd}/parity_model.txt"])
     open(f"{wd}/parity_train.conf", "w").write(conf + "\n")
-    t0 = time.time()
+    t0 = time.perf_counter()
     subprocess.run([REF_BIN, f"config={wd}/parity_train.conf"], check=True,
                    capture_output=True, text=True)
-    t_ref = time.time() - t0
+    t_ref = time.perf_counter() - t0
     open(f"{wd}/parity_pred.conf", "w").write(
         f"task=predict\ndata={te_csv}\ninput_model={wd}/parity_model.txt\n"
         f"output_result={wd}/parity_pred.txt\nis_sigmoid=false\n")

@@ -68,11 +68,12 @@ def main():
                create_objective(cfg.objective_type, cfg.objective_config),
                learner=learner)
         b.train_one_iter(is_eval=False)            # compile + warm
-        t0 = time.time()
+        # perf_counter: monotonic (an NTP step would corrupt the rate)
+        t0 = time.perf_counter()
         for _ in range(3):
             b.train_one_iter(is_eval=False)
         jax.block_until_ready(b.score)
-        results[name] = (time.time() - t0) / 3
+        results[name] = (time.perf_counter() - t0) / 3
         print(f"{name:9s}: {results[name]*1e3:8.1f} ms/iter", file=sys.stderr)
     L.FeatureParallelLearner.ownership = staticmethod(L.balanced_ownership)
     print(f"balanced speedup over static: "

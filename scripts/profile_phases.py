@@ -85,10 +85,11 @@ def run_variant(variant: str, args) -> float:
                  create_objective(cfg.objective_type, cfg.objective_config))
     booster.train_chunk(args.iters)
     jax.block_until_ready(booster.score)
-    start = time.time()
+    # perf_counter: monotonic (an NTP step would corrupt the rate)
+    start = time.perf_counter()
     booster.train_chunk(args.iters)
     jax.block_until_ready(booster.score)
-    elapsed = time.time() - start
+    elapsed = time.perf_counter() - start
     if variant == "nohist":
         grower_depthwise.histogram_leafbatch = real
     return args.iters / elapsed

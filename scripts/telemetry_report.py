@@ -13,6 +13,10 @@ trailing {"summary": true, ...} record) and prints:
     summed phase time (execution spans and trace/compile spans separately),
   - the final kernel-route counter values (cross-host ``allhosts/`` sums
     when the run aggregated them),
+  - the training-health table (ISSUE 2 ``health`` blocks: NaN/Inf and
+    saturation totals, iterations with anomalies, score watermark),
+  - the memory table (ISSUE 2 ``memory`` blocks: peak bytes_in_use,
+    per-phase byte deltas, the dataset-residency report),
   - first/last eval metric values per dataset/metric.
 """
 from __future__ import annotations
@@ -23,7 +27,7 @@ import sys
 
 
 def load(path: str):
-    iters, summary = [], None
+    iters, summary, residency = [], None, None
     with open(path) as f:
         for line in f:
             line = line.strip()
@@ -34,7 +38,35 @@ def load(path: str):
                 summary = rec
             elif "iter" in rec:
                 iters.append(rec)
-    return iters, summary
+            elif "residency" in rec:
+                residency = rec["residency"]
+    return iters, summary, residency
+
+
+def _health_totals(iters, summary):
+    """Cumulative health keys: prefer the summary's block (exact totals,
+    survives partial files), fall back to summing the iteration blocks."""
+    if summary and isinstance(summary.get("health"), dict):
+        return dict(summary["health"])
+    totals = {}
+    for rec in iters:
+        for k, v in (rec.get("health") or {}).items():
+            if k == "eval_divergence":
+                totals["eval_divergence_events"] = (
+                    totals.get("eval_divergence_events", 0) + len(v))
+            elif k == "score_max_abs":
+                totals[k] = max(totals.get(k, 0.0), v)
+            else:
+                totals[k] = totals.get(k, 0) + v
+    return totals
+
+
+def _fmt_bytes(n):
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return ("%.1f %s" % (n, unit)) if unit != "B" else "%d B" % n
+        n /= 1024.0
+    return "%d" % n
 
 
 def _sum_phase(iters, key):
@@ -62,7 +94,7 @@ def _table(title, totals, n_iters):
 
 
 def report(path: str, as_json: bool = False) -> int:
-    iters, summary = load(path)
+    iters, summary, residency = load(path)
     if not iters and summary is None:
         print(f"no telemetry records in {path}", file=sys.stderr)
         return 1
@@ -70,6 +102,11 @@ def report(path: str, as_json: bool = False) -> int:
     exec_totals = _sum_phase(iters, "phase_times")
     trace_totals = _sum_phase(iters, "trace_times")
     counters = (summary or (iters[-1] if iters else {})).get("counters", {})
+    health = _health_totals(iters, summary)
+    mem = (summary or {}).get("memory") or (
+        iters[-1].get("memory") if iters else None) or {}
+    if residency is None:
+        residency = mem.get("residency")
     evals = {}
     for rec in iters:
         for k, v in rec.get("eval_metrics", {}).items():
@@ -83,6 +120,9 @@ def report(path: str, as_json: bool = False) -> int:
             "trace_times_total": {k: round(v, 6)
                                   for k, v in sorted(trace_totals.items())},
             "counters": dict(sorted(counters.items())),
+            "health": dict(sorted(health.items())),
+            "memory": mem,
+            "residency": residency or {},
             "eval_first_last": {k: [v[0], v[-1]]
                                 for k, v in sorted(evals.items())},
         }))
@@ -102,6 +142,44 @@ def report(path: str, as_json: bool = False) -> int:
             out.append(f"{k.ljust(width)}  {v}")
     else:
         out.append("(none recorded)")
+
+    out.append("")
+    out.append("Training health (totals)")
+    out.append("------------------------")
+    if health:
+        width = max(len(k) for k in health)
+        for k, v in sorted(health.items()):
+            val = ("%.6g" % v if isinstance(v, float) else str(v))
+            out.append(f"{k.ljust(width)}  {val}")
+    else:
+        out.append("(no health blocks — train with health=true or "
+                   "metrics_out=)")
+
+    out.append("")
+    out.append("Memory")
+    out.append("------")
+    if mem:
+        out.append("peak bytes_in_use  %s  (source: %s)"
+                   % (_fmt_bytes(mem.get("peak_bytes_in_use", 0)),
+                      mem.get("source", "?")))
+        if "allhosts_peak_bytes_in_use" in mem:
+            out.append("all-hosts peak     %s"
+                       % _fmt_bytes(mem["allhosts_peak_bytes_in_use"]))
+        deltas = mem.get("phase_delta_bytes", {})
+        if deltas:
+            width = max(len(k) for k in deltas)
+            out.append("per-phase cumulative byte deltas:")
+            for k, v in sorted(deltas.items(), key=lambda kv: -abs(kv[1])):
+                out.append(f"  {k.ljust(width)}  {_fmt_bytes(v):>12}")
+    else:
+        out.append("(no memory blocks — train with memory_stats=true or "
+                   "metrics_out=)")
+    if residency:
+        out.append("dataset residency:")
+        width = max(len(k) for k in residency)
+        for k, v in residency.items():
+            val = _fmt_bytes(v) if k.endswith("_bytes") else str(v)
+            out.append(f"  {k.ljust(width)}  {val:>12}")
     if evals:
         out.append("")
         out.append("Eval metrics (first -> last)")

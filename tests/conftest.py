@@ -29,6 +29,26 @@ REFERENCE_BUILD = "/tmp/lightgbm_reference_build"
 REFERENCE_BINARY = os.path.join(REFERENCE_BUILD, "lightgbm")
 
 
+@pytest.fixture(autouse=True)
+def _telemetry_leak_guard():
+    """Telemetry is process-global state: a test that leaves the registry
+    enabled (or a sink open) silently poisons every later test — route
+    counters bleed across tests and sinks append foreign records.  Fail
+    the offender, then clean up so the rest of the suite still runs on a
+    clean registry.  Set up before (torn down after) per-test fixtures,
+    so tests that disable telemetry in their own teardown pass."""
+    from lightgbm_tpu import telemetry
+    yield
+    leaked_enabled = telemetry.enabled()
+    leaked_sink = telemetry.sink_open()
+    telemetry.disable()
+    telemetry.reset()
+    assert not (leaked_enabled or leaked_sink), (
+        "test left telemetry %s — disable() it (or use a fixture) so "
+        "state cannot leak between tests"
+        % ("enabled with an open sink" if leaked_sink else "enabled"))
+
+
 @pytest.fixture(scope="session")
 def reference_binary():
     """Compile the reference from source once per session (differential

@@ -126,6 +126,13 @@ def _check_record_schema(rec):
         assert isinstance(v, (int, float)) and v >= 0
     assert isinstance(rec["counters"], dict)
     assert isinstance(rec["eval_metrics"], dict)
+    # ISSUE 2: metrics_out= armed runs resolve health="auto" and
+    # memory_stats="auto" ON — every record carries both blocks
+    from lightgbm_tpu import health as health_mod
+    for key in health_mod.HEALTH_VEC_KEYS + health_mod.TREE_HEALTH_KEYS:
+        assert key in rec["health"], key
+    assert rec["memory"]["peak_bytes_in_use"] >= 0
+    assert rec["memory"]["source"] in ("device", "host_rss", "unavailable")
 
 
 def test_jsonl_sink_per_iteration_schema(tmp_path):
@@ -156,6 +163,12 @@ def test_jsonl_sink_per_iteration_schema(tmp_path):
     assert hist_counts[0] > 0
     assert hist_counts == sorted(hist_counts)
     assert recs[-1].get("summary") is True
+    # ISSUE 2: the one-shot residency record precedes the iterations, and
+    # the summary carries cumulative health + memory blocks
+    residency = [r for r in recs if "residency" in r]
+    assert residency and residency[0]["residency"]["bin_matrix_bytes"] > 0
+    assert recs[-1]["health"]["anomalous_iterations"] == 0
+    assert recs[-1]["memory"]["peak_bytes_in_use"] > 0
 
 
 def test_jsonl_sink_chunked_one_record_per_iteration(tmp_path):
@@ -187,6 +200,39 @@ def test_sink_closed_after_train_no_leak(tmp_path):
     ds2 = Dataset.from_arrays(x, y, max_bin=32)
     lgb.train(dict(BASE, num_iterations=2), ds2)
     assert len(open(path).read().splitlines()) == n_lines
+
+
+# ------------------------------------------------------------ memory gauges
+
+def test_memory_peak_rebaselines_across_reset():
+    """The allocator's peak_bytes_in_use is monotonic over the PROCESS: a
+    small run after a big one must not inherit the big run's peak, but
+    growth past the post-reset baseline (a transient spike between
+    samples) does count (white-box: stubs the device handle)."""
+    class FakeDev:
+        stats = {}
+
+        def memory_stats(self):
+            return dict(self.stats)
+
+    dev = FakeDev()
+    telemetry._mem_device = dev
+    try:
+        telemetry.reset()
+        dev.stats = {"bytes_in_use": 9_000, "peak_bytes_in_use": 10_000}
+        telemetry._mem_sample()
+        assert telemetry.mem_peak_bytes() == 9_000
+        telemetry.reset()   # fresh run: 10_000 lifetime peak is history
+        dev.stats = {"bytes_in_use": 2_000, "peak_bytes_in_use": 10_000}
+        telemetry._mem_sample()
+        assert telemetry.mem_peak_bytes() == 2_000
+        # allocator peak GREW past the baseline -> this run's spike
+        dev.stats = {"bytes_in_use": 3_000, "peak_bytes_in_use": 11_000}
+        telemetry._mem_sample()
+        assert telemetry.mem_peak_bytes() == 11_000
+    finally:
+        telemetry._mem_device = None
+        telemetry.reset()
 
 
 # ---------------------------------------------------- numerics non-perturbation
