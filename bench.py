@@ -358,7 +358,14 @@ def main() -> int:
                   and (args.grow_policy, args.hist_dtype) != ("leafwise",
                                                               "float32"))
     run_maxbin63 = not args.skip_parity and args.max_bin == 255
-    if run_parity or run_maxbin63:
+    # quantized leaf-wise parity mode: the compacted grower with int8
+    # histograms — prices whether the per-pass quantize/pack overhead
+    # (fixed cost per histogram pass) still binds now that leaf-wise
+    # passes run over bucketed segments instead of full sweeps
+    run_leafwise_int8 = (not args.skip_parity
+                         and (args.grow_policy,
+                              args.hist_dtype) != ("leafwise", "int8"))
+    if run_parity or run_maxbin63 or run_leafwise_int8:
         # the parent's copies of the data are no longer needed; each child
         # rebuilds them, and holding both doubles peak host memory (~2.5 GB
         # of float64 features at the 11M default)
@@ -382,6 +389,18 @@ def main() -> int:
                    ("parity_vs_cuda", "vs_cuda"),
                    ("parity_samples", "samples"),
                    ("parity_spread", "spread")])
+
+    if run_leafwise_int8:
+        lw8_iters = min(args.iters, 8 if args.rows > 4_000_000 else 16)
+        sub_bench("leafwise_int8",
+                  ["--max-bin", str(args.max_bin),
+                   "--iters", str(lw8_iters),
+                   "--grow-policy", "leafwise",
+                   "--hist-dtype", "int8"],
+                  [("leafwise_int8_iters_per_sec", "value"),
+                   ("leafwise_int8_vs_baseline", "vs_baseline"),
+                   ("leafwise_int8_samples", "samples"),
+                   ("leafwise_int8_spread", "spread")])
 
     if run_maxbin63:
         # the reference's own speed configuration (max_bin=63,

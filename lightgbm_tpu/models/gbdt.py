@@ -796,6 +796,7 @@ class GBDT:
                 hist_dtype=self.tree_config.hist_dtype,
                 quant_rounding=self.tree_config.quant_rounding,
                 leafwise_compact=leafwise_compact_on(self.tree_config),
+                num_features=self.num_features,
                 has_bag=has_bag, has_ff=has_ff,
                 train_metric_fns=tuple(s[2] for s in train_specs),
                 valid_metric_fns=tuple(tuple(s[2] for s in specs)
@@ -1570,14 +1571,24 @@ def _get_chunk_program(obj_key, grad_fn, num_class: int, lr: float,
                        hist_chunk: int = 0, hist_dtype: str = "float32",
                        quant_rounding: str = "nearest",
                        leafwise_compact: bool = False,
+                       num_features: int = 0,
                        has_bag: bool, has_ff: bool,
                        train_metric_fns: tuple = (),
                        valid_metric_fns: tuple = (),
                        health_fn=None):
+    # the RESOLVED pallas-partition/DMA-overlap bits (and the backend
+    # identity) are part of the key: __graft_entry__ flips
+    # LGBM_TPU_NO_PALLAS mid-process (PROFILE.md's A/B flips
+    # LGBM_TPU_PARTITION_NO_OVERLAP), and a stale program would keep the
+    # old kernel routing
+    from ..ops.compact import pallas_partition_ok, partition_overlap_on
+    use_pp = leafwise_compact and grow_policy != "depthwise" \
+        and pallas_partition_ok(num_features)
     key = (obj_key, id(grad_fn), num_class, lr, grow_policy, num_leaves,
            num_bins_max, min_data_in_leaf, min_sum_hessian_in_leaf,
            max_depth, hist_chunk, hist_dtype, quant_rounding,
-           leafwise_compact, has_bag, has_ff,
+           leafwise_compact, use_pp, use_pp and partition_overlap_on(),
+           jax.default_backend(), has_bag, has_ff,
            tuple(id(f) for f in train_metric_fns),
            tuple(tuple(id(f) for f in fns) for fns in valid_metric_fns),
            id(health_fn) if health_fn is not None else None)
@@ -1597,11 +1608,11 @@ def _get_chunk_program(obj_key, grad_fn, num_class: int, lr: float,
         # by direct train_chunk calls — leaf-wise production training is
         # per-iteration) on the SAME grower as the per-iteration path
         import functools as _ft
-        from ..ops.compact import pallas_partition_ok
         from .grower_leafcompact import grow_tree_leafcompact_impl
         grow = _ft.partial(
             grow_tree_leafcompact_impl,
-            use_pallas_partition=pallas_partition_ok())
+            use_pallas_partition=use_pp,
+            partition_overlap=partition_overlap_on())
     else:
         from .grower import grow_tree_impl as grow
     lrf = jnp.float32(lr)
@@ -1679,11 +1690,15 @@ def _serial_learner(gbdt: GBDT, bins, grad, hess, row_mask, feature_mask):
         # compacted growth subsumes leafwise_segments: each split touches
         # only the smaller child's rows, so whole-tree dispatches stay
         # short even at bench scale (grower_leafcompact.py)
-        from ..ops.compact import pallas_partition_ok
+        from ..ops.compact import pallas_partition_ok, partition_overlap_on
         from .grower_leafcompact import grow_tree_leafcompact
+        # both bits are jit STATICS, so an env flip re-dispatches here
+        # (the chunk-program caches carry them in their keys instead)
         return grow_tree_leafcompact(
             bins, grad, hess, row_mask, feature_mask, gbdt.num_bins_device,
-            use_pallas_partition=pallas_partition_ok(), **kwargs)
+            use_pallas_partition=pallas_partition_ok(gbdt.num_features),
+            partition_overlap=partition_overlap_on(),
+            **kwargs)
     segments = getattr(gbdt.tree_config, "leafwise_segments", 1)
     if segments > 1:
         from .grower import grow_tree_segmented

@@ -45,9 +45,19 @@ pipeline).  This grower matches a jit-free replay of the identical ops
 BIT FOR BIT (the masked grower is the one carrying the FMA dust there);
 int8 CPU cross-grower comparisons are therefore structure-exact but
 value-tolerant, while f32 histograms (no trailing dequantize multiply)
-and the TPU paths are bit-identical across growers.  Serial learner
-only; the parallel learners keep the masked grower (their row shards
-stay put).
+and the TPU paths are bit-identical across growers.
+
+Runs under the serial learner AND the data-parallel learner's BOTH
+histogram-reduction schedules (parallel/learners.DataParallelLearner):
+each shard keeps its LOCAL rows physically partitioned, and the
+per-split smaller-child histograms are either psum'd whole
+(``dp_schedule=psum``) or psum_scatter'd by contiguous feature block
+with an owned-feature search + packed SplitInfo allreduce
+(``reduce_scatter`` — the reference's N-machine ownership schedule,
+data_parallel_tree_learner.cpp:135-235, in its native growth order).
+The hist_reduce/int_hist_reduce/split_finder/own_slice seams below
+carry both; the histogram slice tier is pmax-synchronized so the
+collectives inside the tier switch stay uniform across shards.
 """
 from __future__ import annotations
 
@@ -70,7 +80,8 @@ class _CompactState(NamedTuple):
     seg_start: jax.Array        # [L] i32 — leaf -> lane range start
     seg_cnt: jax.Array          # [L] i32 — physical lane count
     seg_bucket: jax.Array       # [L] i32 — static width tier
-    hist_cache: jax.Array       # [L, F, B, 3]
+    hist_cache: jax.Array       # [L, F, B, 3] (owned Fb block under the
+                                # reduce_scatter ownership schedule)
     cand_gain: jax.Array        # [L]
     cand_feature: jax.Array
     cand_threshold: jax.Array
@@ -91,7 +102,7 @@ class _CompactState(NamedTuple):
     static_argnames=("num_leaves", "num_bins_max", "min_data_in_leaf",
                      "min_sum_hessian_in_leaf", "max_depth", "hist_backend",
                      "hist_chunk", "compute_dtype", "use_pallas_partition",
-                     "interpret"))
+                     "partition_overlap", "interpret"))
 def grow_tree_leafcompact(bins, grad, hess, row_mask, feature_mask,
                           num_bins, *, num_leaves: int, num_bins_max: int,
                           min_data_in_leaf: int,
@@ -100,6 +111,7 @@ def grow_tree_leafcompact(bins, grad, hess, row_mask, feature_mask,
                           hist_chunk: int = 16384,
                           compute_dtype=jnp.float32,
                           use_pallas_partition: bool = False,
+                          partition_overlap: bool = True,
                           interpret: bool = False) -> TreeArrays:
     return grow_tree_leafcompact_impl(
         bins, grad, hess, row_mask, feature_mask, num_bins,
@@ -108,7 +120,8 @@ def grow_tree_leafcompact(bins, grad, hess, row_mask, feature_mask,
         min_sum_hessian_in_leaf=min_sum_hessian_in_leaf,
         max_depth=max_depth, hist_backend=hist_backend,
         hist_chunk=hist_chunk, compute_dtype=compute_dtype,
-        use_pallas_partition=use_pallas_partition, interpret=interpret)
+        use_pallas_partition=use_pallas_partition,
+        partition_overlap=partition_overlap, interpret=interpret)
 
 
 def grow_tree_leafcompact_impl(bins, grad, hess, row_mask, feature_mask,
@@ -120,9 +133,12 @@ def grow_tree_leafcompact_impl(bins, grad, hess, row_mask, feature_mask,
                                hist_chunk: int = 16384,
                                compute_dtype=jnp.float32,
                                use_pallas_partition: bool = False,
+                               partition_overlap: bool = True,
                                interpret: bool = False,
                                hist_reduce=None, hist_axis=None,
-                               stat_reduce=None,
+                               int_hist_reduce=None, split_finder=None,
+                               stat_reduce=None, own_slice=None,
+                               root_hist_reduce=None,
                                return_state: bool = False):
     """Core (not jitted; callers wrap it).  ``return_state`` exposes the
     full _CompactState for differential debugging against
@@ -136,7 +152,21 @@ def grow_tree_leafcompact_impl(bins, grad, hess, row_mask, feature_mask,
     switch (local, collective-free — each shard picks its own tier) and
     the histogram switch, whose tier selector is pmax-synchronized
     across shards (every shard takes the same branch, so the psum
-    inside it lines up)."""
+    inside it lines up).
+
+    int_hist_reduce/split_finder/own_slice/root_hist_reduce: the
+    reduce_scatter OWNERSHIP seams, same contract as
+    grower.grow_tree_impl — hist_reduce becomes a feature-block
+    psum_scatter (int_hist_reduce its int-domain twin for the quantized
+    path), so every per-split histogram and the hist cache hold only
+    this shard's OWNED block; split_finder must then be the owned-search
+    + SplitInfo-allreduce composite returning GLOBAL feature indices,
+    and feature_mask/num_bins the owned slices
+    (learners.DataParallelLearner._compact_grow_fn).  The root is built
+    replicated at full F (root_hist_reduce, then own_slice caches the
+    owned block) so root stats stay exact on feature-padding shards.
+    The PANE keeps all F features either way — the winning feature is
+    global, and partitioning needs its bin row."""
     F, N = bins.shape
     R = pane_rows(F)            # plane-pane rows (ops/compact.pack_planes)
     L = num_leaves
@@ -156,19 +186,24 @@ def grow_tree_leafcompact_impl(bins, grad, hess, row_mask, feature_mask,
         hist = build_histogram(hbins, hg, hh, hmask, B,
                                backend=hist_backend, chunk=hist_chunk,
                                compute_dtype=compute_dtype,
-                               axis_name=hist_axis, salt=salt)
+                               axis_name=hist_axis,
+                               int_reduce=int_hist_reduce, salt=salt)
         # the quantized path reduces its INT accumulators internally over
-        # hist_axis (grower.grow_tree_impl's rule, kept identical)
+        # hist_axis (grower.grow_tree_impl's rule, kept identical) — psum
+        # by default, the ownership feature-block scatter when
+        # int_hist_reduce is set
         if hist_reduce is not None and not (
                 str(compute_dtype).startswith("int8")
                 and hist_axis is not None):
             hist = hist_reduce(hist)
         return hist
 
+    finder = split_finder or find_best_split
+
     def _finder(hist, sum_g, sum_h, cnt):
-        return find_best_split(hist, sum_g, sum_h, cnt, num_bins,
-                               feature_mask, float(min_data_in_leaf),
-                               float(min_sum_hessian_in_leaf))
+        return finder(hist, sum_g, sum_h, cnt, num_bins,
+                      feature_mask, float(min_data_in_leaf),
+                      float(min_sum_hessian_in_leaf))
 
     def _depth_gate(res, depth):
         if max_depth > 0:
@@ -196,11 +231,29 @@ def grow_tree_leafcompact_impl(bins, grad, hess, row_mask, feature_mask,
     # ---- root (BeforeTrain): full-data pass over the ORIGINAL arrays —
     # identical to grower.grow_tree's root, so the two growers share root
     # histograms bit for bit
-    root_hist = hist_of(bins, grad, hess, row_mask)
+    if own_slice is not None:
+        # ownership (reduce_scatter) schedule: build the ROOT replicated
+        # — full F, plain psum — so root stats are exact on every shard
+        # including feature-PADDING shards (whose owned block is all
+        # zeros), then cache only the owned slice (grow_tree_impl's rule)
+        full = build_histogram(bins, grad, hess, row_mask, B,
+                               backend=hist_backend, chunk=hist_chunk,
+                               compute_dtype=compute_dtype,
+                               axis_name=hist_axis)
+        if root_hist_reduce is not None and not (
+                str(compute_dtype).startswith("int8")
+                and hist_axis is not None):
+            full = root_hist_reduce(full)
+        root_hist = own_slice(full)
+    else:
+        full = root_hist = hist_of(bins, grad, hess, row_mask)
     if str(compute_dtype).startswith("int8"):
         # any single feature's bins sum to the exact quantized totals
-        # (grower.grow_tree's int8 root-stat rule, kept bit-identical)
-        root_stats = jnp.sum(root_hist[0], axis=0)
+        # (grower.grow_tree's int8 root-stat rule, kept bit-identical;
+        # under the ownership schedule the stats must come from the
+        # replicated full-F root, not the owned block — a feature-padding
+        # shard's block is all zeros)
+        root_stats = jnp.sum(full[0], axis=0)
     else:
         maskf = row_mask.astype(f32)
         root_stats = jnp.stack([jnp.sum(grad * maskf),
@@ -233,7 +286,9 @@ def grow_tree_leafcompact_impl(bins, grad, hess, row_mask, feature_mask,
         seg_start=zeros_i,
         seg_cnt=zeros_i.at[0].set(N),
         seg_bucket=zeros_i.at[0].set(bucket_of(N)),
-        hist_cache=jnp.zeros((L, F, B, 3), f32).at[0].set(root_hist),
+        # owned-block shape under the ownership schedule, full F otherwise
+        hist_cache=jnp.zeros((L,) + root_hist.shape, f32).at[0].set(
+            root_hist),
         cand_gain=neg_inf.at[0].set(root_best.gain),
         cand_feature=zeros_i.at[0].set(root_best.feature),
         cand_threshold=zeros_i.at[0].set(root_best.threshold),
@@ -268,6 +323,7 @@ def grow_tree_leafcompact_impl(bins, grad, hess, row_mask, feature_mask,
             plcnt = jnp.sum(inseg & ~go_right).astype(jnp.int32)
             new_seg = partition_segment(seg, mask3, delta, cnt, plcnt,
                                         use_pallas=use_pallas_partition,
+                                        overlap=partition_overlap,
                                         interpret=interpret)
             pane2 = jax.lax.dynamic_update_slice(pane, new_seg,
                                                  (jnp.int32(0), cs))

@@ -11,18 +11,24 @@ of the indices: the [R, N] int8 plane matrix (bin rows + grad/hess
 bit-planes + validity) is kept physically partitioned, and each split
 stably partitions the parent's lane range in one streaming sweep.
 
-The Pallas kernel (TPU): grid = (2 passes, lane blocks), sequential.  Pass
-0 compacts the left rows, pass 1 the right rows — two sweeps so a later
-left write can never clobber earlier right data.  Per block the lane
-compaction is pure MXU: an exclusive prefix-sum of the selection mask via
-a strict-lower-triangular int8 matmul, a one-hot selection matrix built by
-an iota compare, and an int8 x int8 -> int32 selection matmul that moves
-whole [R, block] panes (f32 grad/hess travel bit-exactly as 4 int8
-planes).  The compacted block is DMA'd to the output at a running lane
-offset carried in SMEM; consecutive writes overlap-overwrite each other's
-tails, so every write is a full aligned block.  Cost per partitioned row:
-block x R int8 MACs + ~3 bytes of HBM traffic per plane — ~0.4% of the
-histogram MACs the compaction saves (PROFILE.md).
+The Pallas kernel (TPU): grid = (lane blocks,), sequential; BOTH streams
+(left rows, then right rows) run inside each grid step, so one sweep over
+the data compacts both sides.  Per block the lane compaction is pure MXU:
+an exclusive prefix-sum of the selection mask via a strict-lower-
+triangular int8 matmul, a one-hot selection matrix built by an iota
+compare, and an int8 x int8 -> int32 selection matmul that moves whole
+[R, block] panes (f32 grad/hess travel bit-exactly as 4 int8 planes).
+Each stream's compacted lanes are DMA'd to the output through a
+read-modify-write window at a running lane offset carried in SMEM.  By
+default the per-block window DMAs are OVERLAPPED (both window reads
+issue up front and the left write-back flies under the right blend): the
+two streams' fresh lane ranges are always disjoint, but their
+128-aligned RMW padding can overlap, so the right blend patches this
+block's fresh left lanes in VMEM from a third selection matmul instead
+of re-reading them through HBM — only the two write-backs stay ordered.
+Cost per partitioned row: block x R int8 MACs (x1.5 with the overlap
+patch) + ~3 bytes of HBM traffic per plane — ~0.6% of the histogram MACs
+the compaction saves (PROFILE.md).
 
 The XLA oracle (CPU/tests): a stable argsort formulation with identical
 semantics — the kernel is differentially tested against it.
@@ -36,18 +42,61 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-BLOCK = 2048  # partition lane block: [R<=64, 2048] int8 panes + a [2048,
-              # 2048] int8 selection matrix = ~4.3 MB VMEM
+# JAX-version compat: the TPU host runs a newer JAX where these carry
+# their current names; older releases (this CPU test container) spell
+# them pltpu.ANY / pltpu.TPUCompilerParams.  ANY-vs-HBM only matters to
+# real Mosaic lowering (see the out_specs comment below) — interpret
+# mode treats them alike.
+_HBM_SPACE = getattr(pltpu, "HBM", pltpu.ANY)
+_CompilerParams = getattr(pltpu, "CompilerParams",
+                          getattr(pltpu, "TPUCompilerParams", None))
+
+BLOCK = 2048  # partition lane block; the kernel's VMEM working set at
+              # this block (pane slices, the [2176, 2048] one-hot
+              # selection matrix, the RMW window buffers and blend
+              # temporaries) is priced by partition_vmem_bytes below,
+              # which gates eligibility at PARTITION_VMEM_BUDGET
 
 
-def pallas_partition_ok() -> bool:
+# VMEM ceiling for the partition kernel's working set.  Past it Mosaic
+# fails to ALLOCATE (wide-F datasets), so eligibility must be gated here
+# rather than discovered as a compile error.  12 MiB of the ~16 MiB/core
+# leaves headroom for Mosaic's own spills; with the overlap schedule's
+# temporary count the estimate admits pane heights up to R≈88 (F≈79) at
+# the default block.  Deliberately conservative: the fallback (XLA
+# argsort oracle) is correctness-neutral, an on-device allocation
+# failure is not.
+PARTITION_VMEM_BUDGET = 12 << 20
+
+
+def partition_vmem_bytes(num_features: int, block: int = BLOCK) -> int:
+    """Working-set estimate (bytes) of the partition kernel at this pane
+    height: double-buffered input blocks, the matmul operand matrices,
+    the RMW window buffers and the i32 shifted/keep/blend temporaries.
+    Sized for the default OVERLAP schedule, whose right-blend merge
+    keeps more [R, win] i32 temporaries live at once (merged/keep_lr/
+    shifted_r/keep_r around the blend) than the serialized kernel's
+    three."""
+    R = pane_rows(num_features)
+    win = block + 128
+    return (2 * (R + 1) * block     # pipelined seg+mask input blocks, int8
+            + block * block         # strict-lower-triangular operand, int8
+            + win * block           # one-hot selection matrix, int8
+            + 2 * R * win           # RMW window buffers, int8
+            + 4 * 4 * R * win)      # i32 temporaries live around the blend
+
+
+def pallas_partition_ok(num_features: int | None = None) -> bool:
     """Eligibility of the Pallas partition kernel: TPU default backend,
     unless LGBM_TPU_NO_PALLAS=1 — the escape hatch a mixed-backend
     process (TPU backend up, computation steered onto virtual CPU
     devices, e.g. __graft_entry__.dryrun_multichip) sets so kernels
-    never land on a CPU mesh.  Every outcome is counted (telemetry) —
-    the runtime record of which partition route the process baked into
-    its programs."""
+    never land on a CPU mesh.  ``num_features`` (when the caller knows
+    it) additionally gates on the kernel's VMEM working set: wide-F
+    datasets whose plane pane exceeds PARTITION_VMEM_BUDGET fall back to
+    the XLA argsort oracle instead of failing to compile.  Every outcome
+    is counted (telemetry) — the runtime record of which partition route
+    the process baked into its programs."""
     import os
     from .. import telemetry
     if os.environ.get("LGBM_TPU_NO_PALLAS", "") == "1":
@@ -55,6 +104,10 @@ def pallas_partition_ok() -> bool:
         # counting per outcome CHANGE keeps the counter at per-decision
         # magnitude like the trace-time counters
         telemetry.count_route("partition_ok", "partition/env_no_pallas")
+        return False
+    if (num_features is not None
+            and partition_vmem_bytes(num_features) > PARTITION_VMEM_BUDGET):
+        telemetry.count_route("partition_ok", "partition/wide_f_fallback")
         return False
     ok = jax.default_backend() == "tpu"
     telemetry.count_route("partition_ok",
@@ -131,10 +184,133 @@ def _partition_kernel(mask_ref, scal_ref, seg_ref, out_ref, win_ref,
         offs_ref[p] = offs_ref[p] + used
 
 
-@functools.partial(jax.jit, static_argnames=("block", "use_pallas",
-                                             "interpret"))
+def _partition_kernel_overlap(mask_ref, scal_ref, seg_ref, out_ref,
+                              winl_ref, winr_ref, offs_ref,
+                              seml_ref, semr_ref, *, R, block):
+    """Grid (nblocks,): both streams per lane block, window DMAs
+    OVERLAPPED.
+
+    The serialized kernel round-trips through HBM between the streams
+    (in-L → out-L → in-R → out-R) because the right window's read must
+    see the left window's write wherever their 128-aligned RMW paddings
+    overlap.  Here both window READS issue up front (each sees pre-step
+    HBM bytes) and overlap the selection matmuls; the left write-back
+    flies under the right stream's compute; and the right blend patches
+    this block's fresh left lanes VMEM-side — a third one-hot matmul
+    places the SAME left rows at their right-window coordinates — so it
+    never needs the post-left-write HBM state.  Only the two write-backs
+    stay ordered (their paddings can carry differing bytes; the merged
+    right window must win).  Bit-identical output to the serialized
+    kernel by construction: the fresh lane ranges are disjoint and every
+    patched byte equals what the HBM round-trip would have returned."""
+    j = pl.program_id(0)
+
+    @pl.when(j == 0)
+    def _():
+        offs_ref[0] = 0
+        offs_ref[1] = 0
+
+    delta = scal_ref[0]
+    plcnt = scal_ref[1]
+    win = block + 128
+
+    m = mask_ref[...].astype(jnp.int32)                    # [1, block]
+    iota_t = jax.lax.broadcasted_iota(jnp.int32, (win, block), 0)
+    lt = (jax.lax.broadcasted_iota(jnp.int32, (block, block), 0)
+          < jax.lax.broadcasted_iota(
+              jnp.int32, (block, block), 1)).astype(jnp.int8)
+    lane_w = jax.lax.broadcasted_iota(jnp.int32, (R, win), 1)
+    pane = seg_ref[...]                                    # [R, block] int8
+
+    base_l = delta + offs_ref[0]
+    base_r = delta + plcnt + offs_ref[1]
+    p0l = (base_l // 128) * 128
+    p0r = (base_r // 128) * 128
+
+    # both RMW window reads start immediately and fly under the matmuls;
+    # neither depends on the other stream's write
+    in_l = pltpu.make_async_copy(out_ref.at[:, pl.ds(p0l, win)], winl_ref,
+                                 seml_ref)
+    in_l.start()
+    in_r = pltpu.make_async_copy(out_ref.at[:, pl.ds(p0r, win)], winr_ref,
+                                 semr_ref)
+    in_r.start()
+
+    def stats(p):
+        mi = (m == 1 - p).astype(jnp.int32)                # [1, block]
+        used = jnp.sum(mi)
+        pos = jax.lax.dot_general(
+            mi.astype(jnp.int8), lt,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)              # [1, block]
+        return mi, used, pos
+
+    def place(mi, used, pos, shift):
+        """Land stream rows at window lanes pos + shift (negative shifts
+        simply match no lane: rows below the window never select)."""
+        sel = ((jnp.broadcast_to(pos, (win, block)) + shift == iota_t)
+               & jnp.broadcast_to(mi == 1, (win, block))).astype(jnp.int8)
+        shifted = jax.lax.dot_general(
+            pane, sel, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32)              # [R, win] i32
+        keep = ((lane_w >= shift) & (lane_w < shift + used)).astype(
+            jnp.int32)
+        return shifted, keep
+
+    mi_l, used_l, pos_l = stats(0)
+    mi_r, used_r, pos_r = stats(1)
+    shifted_l, keep_l = place(mi_l, used_l, pos_l, base_l - p0l)
+    # the SAME left rows at their RIGHT-window coordinates: the VMEM-side
+    # merge operand for wherever [base_l, base_l+used_l) intersects the
+    # right window (whose HBM read predates the left write)
+    merged_l, keep_lr = place(mi_l, used_l, pos_l, base_l - p0r)
+    shifted_r, keep_r = place(mi_r, used_r, pos_r, base_r - p0r)
+
+    in_l.wait()
+    blended_l = (shifted_l * keep_l
+                 + winl_ref[...].astype(jnp.int32) * (1 - keep_l))
+    winl_ref[...] = blended_l.astype(jnp.int8)
+    # the right read may cover lanes the left write is about to touch:
+    # it must have landed before that write starts
+    in_r.wait()
+    out_l = pltpu.make_async_copy(winl_ref, out_ref.at[:, pl.ds(p0l, win)],
+                                  seml_ref)
+    out_l.start()
+    # right blend (overlapping the left write-back): right rows where
+    # they land, this block's fresh left rows where THEY land, pre-step
+    # HBM bytes everywhere else.  keep_r and keep_lr are disjoint — all
+    # fresh left lanes precede delta + plcnt <= base_r.
+    patched = (merged_l * keep_lr
+               + winr_ref[...].astype(jnp.int32) * (1 - keep_lr))
+    blended_r = shifted_r * keep_r + patched * (1 - keep_r)
+    winr_ref[...] = blended_r.astype(jnp.int8)
+    # ordered write-backs: overlapping aligned paddings may carry
+    # differing bytes (stale left-window tail vs merged right window) —
+    # the right window's bytes must win
+    out_l.wait()
+    out_r = pltpu.make_async_copy(winr_ref, out_ref.at[:, pl.ds(p0r, win)],
+                                  semr_ref)
+    out_r.start()
+    out_r.wait()
+
+    offs_ref[0] = offs_ref[0] + used_l
+    offs_ref[1] = offs_ref[1] + used_r
+
+
+def partition_overlap_on() -> bool:
+    """Resolved DMA-overlap schedule bit (the
+    LGBM_TPU_PARTITION_NO_OVERLAP=1 A/B hatch).  Resolved OUTSIDE every
+    jit boundary — partition_segment's non-jitted wrapper reads it per
+    call/trace, and the program-cache key builders (gbdt/learners)
+    include it so a mid-process flip retraces instead of silently
+    reusing the other schedule's kernel."""
+    import os
+    return os.environ.get("LGBM_TPU_PARTITION_NO_OVERLAP", "") != "1"
+
+
 def partition_segment(seg, mask3, delta, cnt, plcnt, *, block: int = BLOCK,
-                      use_pallas: bool = False, interpret: bool = False):
+                      use_pallas: bool = False, interpret: bool = False,
+                      overlap: bool = True):
     """Stable in-segment partition of ``seg``'s lanes [delta, delta+cnt).
 
     seg : [R, W] int8 plane pane (W a multiple of ``block``)
@@ -146,28 +322,54 @@ def partition_segment(seg, mask3, delta, cnt, plcnt, *, block: int = BLOCK,
     Returns the pane with lanes [delta, delta+plcnt) holding the left rows
     in original relative order, [delta+plcnt, delta+cnt) the right rows,
     everything else byte-identical to the input.
+
+    ``overlap`` (Pallas path only): overlapped window DMAs (default; the
+    serialized schedule remains as the A/B reference and the
+    LGBM_TPU_PARTITION_NO_OVERLAP=1 escape hatch).  Both schedules are
+    bit-identical — tests/test_leafcompact.py's regression proves it
+    against the oracle.
+
+    This wrapper is deliberately NOT jitted: the env hatch must resolve
+    per call/trace, and a jitted body would bake the first resolution
+    into the trace cache (jit-under-jit reuses the traced jaxpr without
+    re-running the python body, so an env flip would be ignored even
+    when the OUTER program retraces).
     """
     from .. import telemetry
+    if use_pallas:
+        overlap = overlap and partition_overlap_on()
     telemetry.count("partition/pallas" if use_pallas else "partition/xla")
+    if use_pallas:
+        telemetry.count("partition/dma_overlap" if overlap
+                        else "partition/dma_serial")
     with telemetry.span("partition") as sp:
-        return sp.fence(_partition_segment_impl(
+        return sp.fence(_partition_segment_jit(
             seg, mask3, delta, cnt, plcnt, block=block,
-            use_pallas=use_pallas, interpret=interpret))
+            use_pallas=use_pallas, interpret=interpret, overlap=overlap))
+
+
+@functools.partial(jax.jit, static_argnames=("block", "use_pallas",
+                                             "interpret", "overlap"))
+def _partition_segment_jit(seg, mask3, delta, cnt, plcnt, *, block,
+                           use_pallas, interpret, overlap):
+    return _partition_segment_impl(
+        seg, mask3, delta, cnt, plcnt, block=block,
+        use_pallas=use_pallas, interpret=interpret, overlap=overlap)
 
 
 def _partition_segment_impl(seg, mask3, delta, cnt, plcnt, *, block,
-                            use_pallas, interpret):
+                            use_pallas, interpret, overlap=True):
     # unconditional named_scope: profile_dir= traces label the kernel /
     # oracle ops "partition", matching the telemetry span and JSONL phase
     # key whether or not telemetry is armed (ISSUE 2 profiler alignment)
     with jax.named_scope("partition"):
         return _partition_segment_scoped(
             seg, mask3, delta, cnt, plcnt, block=block,
-            use_pallas=use_pallas, interpret=interpret)
+            use_pallas=use_pallas, interpret=interpret, overlap=overlap)
 
 
 def _partition_segment_scoped(seg, mask3, delta, cnt, plcnt, *, block,
-                              use_pallas, interpret):
+                              use_pallas, interpret, overlap=True):
     R, W = seg.shape
     assert W % block == 0, (W, block)
     lane = jnp.arange(W, dtype=jnp.int32)
@@ -175,8 +377,25 @@ def _partition_segment_scoped(seg, mask3, delta, cnt, plcnt, *, block,
 
     if use_pallas:
         scal = jnp.stack([delta, plcnt]).astype(jnp.int32)
+        if overlap:
+            kernel = functools.partial(_partition_kernel_overlap,
+                                       R=R, block=block)
+            scratch = [
+                pltpu.VMEM((R, block + 128), jnp.int8),
+                pltpu.VMEM((R, block + 128), jnp.int8),
+                pltpu.SMEM((2,), jnp.int32),
+                pltpu.SemaphoreType.DMA(()),
+                pltpu.SemaphoreType.DMA(()),
+            ]
+        else:
+            kernel = functools.partial(_partition_kernel, R=R, block=block)
+            scratch = [
+                pltpu.VMEM((R, block + 128), jnp.int8),
+                pltpu.SMEM((2,), jnp.int32),
+                pltpu.SemaphoreType.DMA(()),
+            ]
         out = pl.pallas_call(
-            functools.partial(_partition_kernel, R=R, block=block),
+            kernel,
             grid=(W // block,),
             in_specs=[
                 pl.BlockSpec((1, block), lambda j: (0, j)),
@@ -185,14 +404,10 @@ def _partition_segment_scoped(seg, mask3, delta, cnt, plcnt, *, block,
             ],
             # HBM, not ANY: Mosaic may place ANY in VMEM, where dynamic
             # DMA lane offsets (128-aligned here) are disallowed
-            out_specs=pl.BlockSpec(memory_space=pltpu.HBM),
+            out_specs=pl.BlockSpec(memory_space=_HBM_SPACE),
             out_shape=jax.ShapeDtypeStruct((R, W + block + 256), jnp.int8),
-            scratch_shapes=[
-                pltpu.VMEM((R, block + 128), jnp.int8),
-                pltpu.SMEM((2,), jnp.int32),
-                pltpu.SemaphoreType.DMA(()),
-            ],
-            compiler_params=pltpu.CompilerParams(
+            scratch_shapes=scratch,
+            compiler_params=_CompilerParams(
                 dimension_semantics=("arbitrary",)),
             interpret=interpret,
         )(mask3[None, :], scal, seg)

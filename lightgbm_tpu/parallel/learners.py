@@ -132,6 +132,53 @@ def ownership_finder(own_s, axis_name):
     return finder
 
 
+def dp_ownership_seams(F: int, num_shards: int):
+    """Contiguous-feature-block ownership seams for the data-parallel
+    reduce_scatter schedule (data_parallel_tree_learner.cpp:135-235),
+    shared by the masked and COMPACTED leaf-wise shard closures: returns
+    a traced-context function (fmask, nbins) -> kwargs for the grower's
+    ownership seam set.  ``fmask_own``/``nbins_own`` are the owned
+    slices to pass positionally; the rest map 1:1 onto
+    grow_tree_impl/grow_tree_leafcompact_impl's keyword seams."""
+    Fb = -(-F // num_shards)
+    Fpad = Fb * num_shards
+
+    def seams(fmask, nbins):
+        rank = jax.lax.axis_index(DATA_AXIS)
+        idx = rank * Fb + jnp.arange(Fb, dtype=jnp.int32)
+        ownok = idx < F
+        own_s = jnp.minimum(idx, F - 1)
+
+        def pad_f(x):
+            if Fpad == F:
+                return x
+            widths = [(0, 0)] * x.ndim
+            widths[0] = (0, Fpad - F)
+            return jnp.pad(x, widths)
+
+        def scatter0(h):
+            # per-split [F, B, ...] histogram (f32) or [F, B, lanes]
+            # INT accumulator — both carry features on axis 0
+            return jax.lax.psum_scatter(
+                pad_f(h), DATA_AXIS, scatter_dimension=0, tiled=True)
+
+        def own_slice(h):
+            # replicated full root histogram -> this shard's block
+            return jax.lax.dynamic_slice_in_dim(
+                pad_f(h), rank * Fb, Fb, axis=0)
+
+        return dict(
+            fmask_own=fmask[own_s] & ownok,
+            nbins_own=jnp.take(nbins, own_s),
+            hist_reduce=scatter0, int_hist_reduce=scatter0,
+            hist_axis=DATA_AXIS,
+            stat_reduce=lambda s: jax.lax.psum(s, DATA_AXIS),
+            root_hist_reduce=lambda h: jax.lax.psum(h, DATA_AXIS),
+            own_slice=own_slice,
+            split_finder=ownership_finder(own_s, DATA_AXIS))
+    return seams
+
+
 def _tree_out_specs(data_axis=None):
     """TreeArrays out_specs: everything replicated except the row-sharded
     leaf-id vector."""
@@ -218,45 +265,16 @@ class DataParallelLearner(_ParallelLearnerBase):
         N-machine mode in its native growth order
         (data_parallel_tree_learner.cpp:135-235 driving
         serial_tree_learner.cpp:119-153)."""
-        Fb = -(-F // num_shards)
-        Fpad = Fb * num_shards
+        seams = dp_ownership_seams(F, num_shards)
 
         def shard_grow(bins_s, grad_s, hess_s, mask_s, fmask, nbins,
                        **extra):
-            rank = jax.lax.axis_index(DATA_AXIS)
-            idx = rank * Fb + jnp.arange(Fb, dtype=jnp.int32)
-            ownok = idx < F
-            own_s = jnp.minimum(idx, F - 1)
-            fmask_own = fmask[own_s] & ownok
-            nbins_own = jnp.take(nbins, own_s)
-
-            def pad_f(x):
-                if Fpad == F:
-                    return x
-                widths = [(0, 0)] * x.ndim
-                widths[0] = (0, Fpad - F)
-                return jnp.pad(x, widths)
-
-            def scatter0(h):
-                # per-split [F, B, ...] histogram (f32) or [F, B, lanes]
-                # INT accumulator — both carry features on axis 0
-                return jax.lax.psum_scatter(
-                    pad_f(h), DATA_AXIS, scatter_dimension=0, tiled=True)
-
-            def own_slice(h):
-                return jax.lax.dynamic_slice_in_dim(
-                    pad_f(h), rank * Fb, Fb, axis=0)
-
+            s = seams(fmask, nbins)
             return grow_tree_impl(
-                bins_s, grad_s, hess_s, mask_s, fmask_own, nbins_own,
-                hist_reduce=scatter0, int_hist_reduce=scatter0,
-                hist_axis=DATA_AXIS,
-                stat_reduce=lambda s: jax.lax.psum(s, DATA_AXIS),
-                root_hist_reduce=lambda h: jax.lax.psum(h, DATA_AXIS),
-                own_slice=own_slice,
-                split_finder=ownership_finder(own_s, DATA_AXIS),
+                bins_s, grad_s, hess_s, mask_s,
+                s.pop("fmask_own"), s.pop("nbins_own"),
                 partition_bins=bins_s,
-                **kwargs, **extra)
+                **s, **kwargs, **extra)
         return shard_grow
 
     def _scatter_grow_fn(self, grow, kwargs, F: int, num_shards: int):
@@ -345,9 +363,9 @@ class DataParallelLearner(_ParallelLearnerBase):
         # reduce_scatter in the fused depthwise chunk; the leaf-wise
         # per-iteration path has its own scatter closure (__call__)
         use_scatter = self._schedule() == "reduce_scatter" and depthwise
-        use_compact = (not depthwise
-                       and self._schedule() == "psum"
-                       and self._leafwise_compact_enabled())
+        # the compacted grower covers BOTH schedules (_compact_grow_fn
+        # dispatches): no masked-grower fall-through under reduce_scatter
+        use_compact = not depthwise and self._leafwise_compact_enabled()
         num_features = gbdt.num_features
         # in-program health vector: local reductions + psum/pmax over the
         # data axis, so every shard carries the identical global vector
@@ -357,9 +375,20 @@ class DataParallelLearner(_ParallelLearnerBase):
             from ..health import make_health_fn
             health_fn = make_health_fn(
                 self.tree_config.hist_dtype == "int8", DATA_AXIS)
+        # the RESOLVED pallas-partition and DMA-overlap bits and the
+        # backend/device identity are part of the program key:
+        # __graft_entry__ flips LGBM_TPU_NO_PALLAS mid-process (and
+        # steers onto virtual CPU meshes), PROFILE.md's A/B flips
+        # LGBM_TPU_PARTITION_NO_OVERLAP, and a stale program would keep
+        # the old kernel routing either way
+        from ..ops.compact import pallas_partition_ok, partition_overlap_on
+        use_pp = use_compact and pallas_partition_ok(num_features)
         key = (obj_key, id(grad_fn), num_shards, num_class, lr, depthwise,
                tuple(sorted(kwargs.items())), has_bag, has_ff, n_true,
                shard_layout, needs_global_score, use_scatter, use_compact,
+               self._schedule(), use_pp,
+               use_pp and partition_overlap_on(), jax.default_backend(),
+               getattr(self.config, 'device_type', ''),
                num_features, bool(health),
                tuple(id(f) for f in train_metric_fns),
                tuple(tuple(id(f) for f in fns) for fns in valid_metric_fns))
@@ -367,20 +396,7 @@ class DataParallelLearner(_ParallelLearnerBase):
         if prog is not None:
             return prog, num_shards
 
-        if depthwise:
-            grow = grow_tree_depthwise
-        elif use_compact:
-            # same grower on the chunk path as on __call__'s
-            # per-iteration path for the same config
-            import functools as _ft
-            from ..models.grower_leafcompact import (
-                grow_tree_leafcompact_impl)
-            from ..ops.compact import pallas_partition_ok
-            grow = _ft.partial(
-                grow_tree_leafcompact_impl,
-                use_pallas_partition=pallas_partition_ok())
-        else:
-            grow = grow_tree_impl
+        grow = grow_tree_depthwise if depthwise else grow_tree_impl
         lrf = jnp.float32(lr)
 
         def gathered(f):
@@ -431,7 +447,12 @@ class DataParallelLearner(_ParallelLearnerBase):
                         feat_masks, obj_params, train_mparams, valid_bins,
                         valid_scores, valid_mparams):
             from ..models.gbdt import make_chunk_body
-            if use_scatter:
+            if use_compact:
+                # same grower (and the same schedule dispatch) on the
+                # chunk path as on __call__'s per-iteration path
+                grow_fn = self._compact_grow_fn(kwargs, num_features,
+                                                num_shards)
+            elif use_scatter:
                 grow_fn = self._scatter_grow_fn(grow, kwargs, num_features,
                                                 num_shards)
             else:
@@ -488,16 +509,39 @@ class DataParallelLearner(_ParallelLearnerBase):
         from ..models.gbdt import leafwise_compact_on
         return leafwise_compact_on(self.tree_config)
 
-    def _compact_grow_fn(self, kwargs):
-        """Per-shard COMPACTED leaf-wise closure (psum schedule): each
-        shard keeps its local rows physically partitioned
+    def _compact_grow_fn(self, kwargs, F: int, num_shards: int):
+        """Per-shard COMPACTED leaf-wise closure for the ACTIVE schedule:
+        each shard keeps its local rows physically partitioned
         (grower_leafcompact.py) and the per-split smaller-child
-        histograms are psum'd — distributed parity-mode training at the
-        geometric-series cost instead of full sweeps.  The histogram
-        tier is pmax-synced inside the grower so the collectives stay
-        uniform across shards."""
+        histograms are reduced globally — distributed parity-mode
+        training at the geometric-series cost instead of full sweeps.
+        The histogram tier is pmax-synced inside the grower so the
+        collectives stay uniform across shards.
+
+        Under ``psum`` the whole histogram is allreduced; under
+        ``reduce_scatter`` the reference's ownership schedule
+        (data_parallel_tree_learner.cpp:135-235) composes onto the same
+        grower: feature-block psum_scatter (int domain for the quantized
+        path), owned-slice hist cache and split search, packed SplitInfo
+        allreduce — the multi-process default (dp_schedule=auto) no
+        longer falls back to the masked N·(L-1)-sweep grower."""
         from ..models.grower_leafcompact import grow_tree_leafcompact_impl
-        from ..ops.compact import pallas_partition_ok
+        from ..ops.compact import pallas_partition_ok, partition_overlap_on
+        use_pallas = pallas_partition_ok(F)
+        overlap = partition_overlap_on()
+
+        if self._schedule() == "reduce_scatter":
+            seams = dp_ownership_seams(F, num_shards)
+
+            def shard_grow(bins_s, grad_s, hess_s, mask_s, fmask, nbins):
+                s = seams(fmask, nbins)
+                return grow_tree_leafcompact_impl(
+                    bins_s, grad_s, hess_s, mask_s,
+                    s.pop("fmask_own"), s.pop("nbins_own"),
+                    use_pallas_partition=use_pallas,
+                    partition_overlap=overlap,
+                    **s, **kwargs)
+            return shard_grow
 
         def shard_grow(bins_s, grad_s, hess_s, mask_s, fmask, nbins):
             return grow_tree_leafcompact_impl(
@@ -505,7 +549,8 @@ class DataParallelLearner(_ParallelLearnerBase):
                 hist_reduce=lambda h: jax.lax.psum(h, DATA_AXIS),
                 stat_reduce=lambda s: jax.lax.psum(s, DATA_AXIS),
                 hist_axis=DATA_AXIS,
-                use_pallas_partition=pallas_partition_ok(),
+                use_pallas_partition=use_pallas,
+                partition_overlap=overlap,
                 **kwargs)
         return shard_grow
 
@@ -603,12 +648,11 @@ class DataParallelLearner(_ParallelLearnerBase):
             hess = jnp.pad(hess, (0, pad))
             row_mask = jnp.pad(row_mask, (0, pad))
 
-        # compacted leaf-wise under the psum schedule subsumes
-        # segmentation (per-split dispatches are short by construction);
-        # the ownership schedule and the segmented path keep the masked
-        # grower
+        # compacted leaf-wise (EITHER schedule — _compact_grow_fn
+        # dispatches) subsumes segmentation: per-split dispatches are
+        # short by construction.  Only the masked-grower segmented path
+        # remains schedule-split.
         use_compact = (not self._depthwise
-                       and self._schedule() == "psum"
                        and self._leafwise_compact_enabled())
         segments = getattr(self.tree_config, "leafwise_segments", 1)
         if not self._depthwise and segments > 1 and not use_compact:
@@ -620,11 +664,25 @@ class DataParallelLearner(_ParallelLearnerBase):
                 tree = tree._replace(leaf_ids=tree.leaf_ids[:N])
             return tree
         telemetry.count_route(
-            "learner_dp", "learner/dp_" + ("depthwise" if self._depthwise
-                                           else "compact" if use_compact
-                                           else "leafwise"))
+            "learner_dp", "learner/dp_" + (
+                "depthwise" if self._depthwise
+                else ("compact_rs" if self._schedule() == "reduce_scatter"
+                      else "compact") if use_compact
+                else "leafwise"))
 
-        if self._jitted is None:
+        # the per-iteration program must track the resolved
+        # pallas-partition/DMA-overlap bits and backend/device identity,
+        # exactly like the chunk-program caches: __graft_entry__ flips
+        # LGBM_TPU_NO_PALLAS mid-process (PROFILE.md's A/B flips
+        # LGBM_TPU_PARTITION_NO_OVERLAP) and a stale program would keep
+        # the old kernel routing
+        from ..ops.compact import pallas_partition_ok, partition_overlap_on
+        use_pp = use_compact and pallas_partition_ok(F)
+        jit_key = (use_pp, use_pp and partition_overlap_on(),
+                   jax.default_backend(),
+                   getattr(self.config, 'device_type', ''))
+        if self._jitted is None or getattr(self, "_jit_key", None) != jit_key:
+            self._jit_key = jit_key
             kwargs = self._grow_kwargs(gbdt)
             if self._depthwise:
                 def shard_fn(bins_s, grad_s, hess_s, mask_s, fmask, nbins):
@@ -635,7 +693,7 @@ class DataParallelLearner(_ParallelLearnerBase):
                         hist_axis=DATA_AXIS,
                         **kwargs)
             elif use_compact:
-                shard_fn = self._compact_grow_fn(kwargs)
+                shard_fn = self._compact_grow_fn(kwargs, F, num_shards)
             else:
                 # schedule-dispatching leaf-wise closure shared with the
                 # segmented path

@@ -15,6 +15,7 @@ builds it).
 from __future__ import annotations
 
 import argparse
+import hashlib
 import os
 import subprocess
 import sys
@@ -26,6 +27,32 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from bench import make_data
 
 REF_BIN = "/tmp/lightgbm_reference_build/lightgbm"
+
+# Recorded reference-binary AUCs (BASELINE.md tables, measured on real
+# hardware) for --skip-reference runs, PINNED to a digest of
+# bench.make_data's output: the anchors are only valid for the exact
+# data the reference was trained on, so a generator change (or a numpy
+# RandomState behavior change) must be refused, not silently compared.
+#   (rows, test_rows, iters, max_bin) -> (reference AUC, data digest)
+RECORDED_REFERENCE_AUC = {
+    (1_000_000, 200_000, 100, 255): (0.939544, "8d19841668b47c1c"),
+    (1_000_000, 200_000, 30, 255): (0.904741, "8d19841668b47c1c"),
+    (11_000_000, 500_000, 100, 255): (0.914417, "014912f2e0e95113"),
+    (11_000_000, 500_000, 30, 255): (0.881476, "014912f2e0e95113"),
+    (11_000_000, 1_000_000, 100, 63): (0.937752, "0166a0ce9ee1f963"),
+}
+
+
+def data_digest(x: np.ndarray, y: np.ndarray) -> str:
+    """Digest of make_data's output: shape + a ~4096-row stride sample of
+    features and labels (cheap even at 11M rows, and any RNG/generator
+    drift perturbs every strided row)."""
+    h = hashlib.sha256()
+    h.update(np.asarray(x.shape, np.int64).tobytes())
+    step = max(1, len(y) // 4096)
+    h.update(np.ascontiguousarray(x[::step]).tobytes())
+    h.update(np.ascontiguousarray(y[::step]).tobytes())
+    return h.hexdigest()[:16]
 
 
 def auc_manual(labels: np.ndarray, scores: np.ndarray) -> float:
@@ -60,9 +87,12 @@ def main() -> int:
     ap.add_argument("--quant-rounding", default="nearest",
                     choices=["nearest", "stochastic"])
     ap.add_argument("--skip-reference", action="store_true",
-                    help="train/evaluate only our side (compare against "
-                         "a previously recorded reference AUC from the "
-                         "same make_data split — BASELINE.md tables)")
+                    help="train/evaluate only our side and compare "
+                         "against the RECORDED reference AUC "
+                         "(RECORDED_REFERENCE_AUC, from BASELINE.md); "
+                         "each anchor is pinned to a digest of "
+                         "bench.make_data's output and the run refuses "
+                         "stale anchors")
     ap.add_argument("--max-bin", type=int, default=255,
                     help="bin budget for BOTH sides (the reference's "
                          "own default is 255; 63 is its documented "
@@ -129,6 +159,31 @@ def main() -> int:
 
     # ---- reference binary
     if args.skip_reference:
+        # compare against the RECORDED reference AUC — but only after
+        # verifying the data is byte-for-byte what the anchor was
+        # recorded on (a make_data change would silently invalidate
+        # every stored number)
+        key = (args.rows, args.test_rows, args.iters, args.max_bin)
+        anchor = RECORDED_REFERENCE_AUC.get(key)
+        if anchor is None:
+            print(f"no recorded reference anchor for rows={args.rows} "
+                  f"test_rows={args.test_rows} iters={args.iters} "
+                  f"max_bin={args.max_bin}; ours-only run")
+            return 0
+        ref_auc, want_digest = anchor
+        got_digest = data_digest(x, y)
+        if got_digest != want_digest:
+            print(f"STALE ANCHOR: make_data digest {got_digest} != "
+                  f"recorded {want_digest} — the generator (or numpy "
+                  f"RandomState behavior) changed since the reference "
+                  f"AUC was recorded; refusing the comparison.  Rerun "
+                  f"without --skip-reference and re-record.",
+                  file=sys.stderr)
+            return 1
+        print(f"recorded reference AUC {ref_auc:.6f} "
+              f"(anchor digest {want_digest} verified)")
+        print(f"AUC delta (ours - recorded reference): "
+              f"{ours_auc - ref_auc:+.6f}")
         return 0
     if not os.path.exists(REF_BIN):
         print("reference binary not built; skipping reference side")

@@ -37,6 +37,60 @@ def test_partition_kernel_matches_oracle(delta, cnt):
     np.testing.assert_array_equal(oracle, kernel)
 
 
+@pytest.mark.parametrize("delta,cnt", [
+    (0, 8192), (777, 6000), (2047, 4097), (100, 3000), (4095, 2049),
+])
+def test_partition_dma_overlap_bit_identity(delta, cnt):
+    """The overlapped-DMA kernel schedule (both window reads up front,
+    left write-back under the right blend, VMEM-side merge of the fresh
+    left lanes into the right window) must be BIT-identical to both the
+    serialized schedule and the oracle.  W=8192 runs 4 lane blocks, so
+    the running offsets and the cross-block window overlaps (the lanes
+    the merge exists for) are genuinely exercised."""
+    rng = np.random.RandomState(delta * 7 + cnt)
+    R, W = 13, 8192
+    seg, mask3, plcnt = _random_case(rng, R, W, delta, cnt)
+    args = (jnp.asarray(seg), jnp.asarray(mask3), jnp.int32(delta),
+            jnp.int32(cnt), jnp.int32(plcnt))
+    oracle = np.asarray(partition_segment(*args, block=2048))
+    serial = np.asarray(partition_segment(*args, block=2048,
+                                          use_pallas=True, interpret=True,
+                                          overlap=False))
+    overlap = np.asarray(partition_segment(*args, block=2048,
+                                           use_pallas=True, interpret=True,
+                                           overlap=True))
+    np.testing.assert_array_equal(oracle, serial)
+    np.testing.assert_array_equal(oracle, overlap)
+
+
+def test_partition_wide_feature_eligibility(monkeypatch):
+    """Wide-feature datasets whose plane pane blows the kernel's VMEM
+    working set must fall back to the XLA argsort oracle at the
+    ELIGIBILITY rule (pallas_partition_ok), not as a Mosaic compile
+    error — and the fallback is a counted route."""
+    import jax
+    from lightgbm_tpu import telemetry
+    from lightgbm_tpu.ops.compact import (PARTITION_VMEM_BUDGET,
+                                          pallas_partition_ok,
+                                          partition_vmem_bytes)
+    # the byte estimate is monotone in F and crosses the budget in the
+    # F ≈ 100-200 band PROFILE.md flags
+    assert partition_vmem_bytes(28) < PARTITION_VMEM_BUDGET
+    assert partition_vmem_bytes(200) > PARTITION_VMEM_BUDGET
+    # the gate must hold even where the backend says yes
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    telemetry.enable()
+    try:
+        assert pallas_partition_ok(28) is True
+        assert pallas_partition_ok(200) is False
+        assert telemetry.counters().get(
+            "partition/wide_f_fallback", 0) > 0
+        # F-less callers (back-compat) keep the backend-only rule
+        assert pallas_partition_ok() is True
+    finally:
+        telemetry.disable()
+
+
 def test_partition_oracle_semantics():
     """Stable partition of the in-segment lanes; everything else
     preserved byte for byte."""
