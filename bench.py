@@ -154,8 +154,17 @@ def main() -> int:
     # read per chunk) so BENCH_*.json rounds carry the memory trajectory;
     # the armed telemetry also resolves health="auto" ON, so the chunk
     # programs accumulate the in-program health vector (a handful of [C,N]
-    # reductions per iteration — noise next to the histogram passes)
-    telemetry.enable(memory=True)
+    # reductions per iteration — noise next to the histogram passes).
+    # DEPTHWISE runs fence the spans (ISSUE 4): unfenced spans on the
+    # async TPU time the chunk DISPATCH, not its execution, and the
+    # roofline attained rates would be meaningless.  Total timed wall is
+    # unchanged — run_chunks block_until_ready's right after the span
+    # either way, the wait just attributes to train_chunk instead of the
+    # gap.  Leaf-wise stays unfenced: its per-iteration path overlaps
+    # gradient/grow/readback dispatches by design, and fencing would
+    # serialize exactly the overlap prior BENCH rounds measured.
+    telemetry.enable(memory=True,
+                     fence=(args.grow_policy == "depthwise"))
 
     x, y = make_data(args.rows, args.features)
     ds = Dataset.from_arrays(x, y, max_bin=args.max_bin)
@@ -269,11 +278,16 @@ def main() -> int:
                                          args.iters)
     iters_per_sec = float(np.median(samples))
     snap = telemetry.snapshot()
+    from lightgbm_tpu import costmodel
     out = {
         "metric": f"boosting_iters_per_sec_higgs{args.rows // 1000}k_"
                   f"leaves{args.leaves}",
         "value": round(iters_per_sec, 4),
         "unit": "iters/sec",
+        # self-describing host metadata (ISSUE 4): BENCH_r*.json trajectory
+        # entries carry the hardware/runtime they were measured on, so
+        # scripts/perf_gate.py can refuse cross-hardware comparisons
+        "host": costmodel.host_fingerprint(),
         "vs_baseline": round(
             iters_per_sec / reference_iters_per_sec(args.rows), 4),
         "vs_cuda": round(iters_per_sec / cuda_iters_per_sec(args.rows), 4),
@@ -304,6 +318,17 @@ def main() -> int:
                         for k, v in sorted(snap["trace_times"].items())},
         "counters": dict(sorted(snap["counters"].items())),
     }
+
+    # roofline + compile blocks (ISSUE 4): per-phase static program costs
+    # (compiled.cost_analysis) joined to the measured spans — attained
+    # FLOP/s, HBM GB/s, fraction-of-peak on TPU (peaks "unavailable"
+    # elsewhere) — plus the compiled-program inventory (compile seconds,
+    # persistent-cache hits, mid-run recompiles).  perf_gate tracks the
+    # attained fractions across rounds next to the raw rates.
+    if "roofline" in snap:
+        out["roofline"] = snap["roofline"]
+    if "compile" in snap:
+        out["compile"] = snap["compile"]
 
     # memory trajectory (ISSUE 2): peak HBM watermark + dataset residency,
     # so BENCH_*.json rounds stop hand-measuring footprints (PROFILE.md)

@@ -575,42 +575,66 @@ class GBDT:
                      progress_fn: Optional[Callable] = None) -> None:
         """Drive the full training loop (Application::Train,
         application.cpp:239-257), fusing iterations into device chunks when
-        no per-iteration metric output is needed."""
+        no per-iteration metric output is needed.  Any exception escaping
+        the loop (TrainingHealthError halts included) crash-flushes a
+        final telemetry summary record before re-raising, so an aborted
+        run keeps its tail records."""
         if self._mp_fp and not self.chunkable_for(is_eval):
             # the per-iteration fallback would push committed local arrays
             # into the global-mesh program and fail obscurely mid-train
             log.fatal("multi-process feature-parallel training requires "
                       "the fused chunk path: grow_policy=depthwise and a "
                       "device formulation for every configured metric")
-        if not self.chunkable_for(is_eval) or (num_iterations < chunk_size
-                                               and not self._mp_fp):
-            # short runs use the per-iteration path: its grower program is
-            # module-jitted (shared across boosters), while a chunk shorter
-            # than chunk_size would waste the surplus iterations it computes
-            for _ in range(num_iterations):
-                finished = self.train_one_iter(is_eval=is_eval)
-                if save_fn is not None:
-                    save_fn()
-                if progress_fn is not None:
-                    progress_fn(self.iter)
-                if finished:
-                    break
-        else:
-            done = 0
-            while done < num_iterations:
-                # always run the full-size chunk program (a shorter tail
-                # chunk would re-trace the scan and pay a second multi-
-                # minute compile); surplus iterations are rolled back
-                stop = self.train_chunk(chunk_size,
-                                        limit=num_iterations - done,
-                                        is_eval=is_eval)
-                if save_fn is not None:
-                    save_fn()
-                if progress_fn is not None:
-                    progress_fn(self.iter)
-                if stop:
-                    break
-                done += chunk_size
+        try:
+            if not self.chunkable_for(is_eval) or (num_iterations < chunk_size
+                                                   and not self._mp_fp):
+                # short runs use the per-iteration path: its grower program
+                # is module-jitted (shared across boosters), while a chunk
+                # shorter than chunk_size would waste the surplus iterations
+                # it computes
+                for _ in range(num_iterations):
+                    finished = self.train_one_iter(is_eval=is_eval)
+                    if save_fn is not None:
+                        save_fn()
+                    if progress_fn is not None:
+                        progress_fn(self.iter)
+                    if finished:
+                        break
+            else:
+                done = 0
+                while done < num_iterations:
+                    # always run the full-size chunk program (a shorter tail
+                    # chunk would re-trace the scan and pay a second multi-
+                    # minute compile); surplus iterations are rolled back
+                    stop = self.train_chunk(chunk_size,
+                                            limit=num_iterations - done,
+                                            is_eval=is_eval)
+                    if save_fn is not None:
+                        save_fn()
+                    if progress_fn is not None:
+                        progress_fn(self.iter)
+                    if stop:
+                        break
+                    done += chunk_size
+        except BaseException as e:
+            # crash-flush (ISSUE 4): an exception escaping training —
+            # TrainingHealthError halts included — must not lose the
+            # run's tail records.  Write the final summary (marked with
+            # the exception type) and flush the sink before re-raising.
+            # No collectives here: a crashed process cannot be assumed
+            # able to join the cross-host aggregation, and the peer
+            # processes are raising the same (host-replicated) error
+            # rather than waiting in an allgather.
+            if telemetry.sink_active():
+                try:
+                    extra = {"aborted": type(e).__name__,
+                             "iterations": self.iter}
+                    if self._health_monitor is not None:
+                        extra["health"] = self._health_monitor.summary()
+                    telemetry.emit_summary(extra=extra)
+                except Exception:
+                    pass
+            raise
         if self._host_inputs:
             # fold every host's route counters into the leader before the
             # summary.  COLLECTIVE, hence outside any telemetry.enabled()
@@ -1633,7 +1657,9 @@ def _get_chunk_program(obj_key, grad_fn, num_class: int, lr: float,
             body, (score, tuple(valid_scores)), (row_masks, feat_masks))
         return score, vscores, stacked, mvals, hvals
 
-    prog = jax.jit(chunk_fn)
+    from .. import costmodel
+    prog = costmodel.instrument("chunk/serial", jax.jit(chunk_fn),
+                                phase="train_chunk")
     _CHUNK_PROGRAMS[key] = prog
     return prog
 

@@ -66,18 +66,13 @@ class _GrowState(NamedTuple):
     done: jax.Array             # bool scalar
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("num_leaves", "num_bins_max", "min_data_in_leaf",
-                     "min_sum_hessian_in_leaf", "max_depth", "hist_backend",
-                     "hist_chunk", "compute_dtype"))
-def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
-              row_mask: jax.Array, feature_mask: jax.Array,
-              num_bins: jax.Array, *, num_leaves: int, num_bins_max: int,
-              min_data_in_leaf: int, min_sum_hessian_in_leaf: float,
-              max_depth: int = -1, hist_backend: str = "matmul",
-              hist_chunk: int = 16384,
-              compute_dtype=jnp.float32) -> TreeArrays:
+def _grow_tree_fn(bins: jax.Array, grad: jax.Array, hess: jax.Array,
+                  row_mask: jax.Array, feature_mask: jax.Array,
+                  num_bins: jax.Array, *, num_leaves: int, num_bins_max: int,
+                  min_data_in_leaf: int, min_sum_hessian_in_leaf: float,
+                  max_depth: int = -1, hist_backend: str = "matmul",
+                  hist_chunk: int = 16384,
+                  compute_dtype=jnp.float32) -> TreeArrays:
     """Grow one tree on a single device (TreeLearner::Train,
     serial_tree_learner.cpp:119-153).  See ``grow_tree_impl`` for the
     customization seam used by the parallel learners.
@@ -89,6 +84,21 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         min_sum_hessian_in_leaf=min_sum_hessian_in_leaf,
         max_depth=max_depth, hist_backend=hist_backend,
         hist_chunk=hist_chunk, compute_dtype=compute_dtype)
+
+
+# module-level jit shared across boosters, wrapped in the cost registry
+# (lightgbm_tpu/costmodel.py): with telemetry armed, the compiled program's
+# cost_analysis/compile seconds feed the roofline/compile blocks
+from .. import costmodel as _costmodel  # noqa: E402 (after jax imports)
+
+grow_tree = _costmodel.instrument(
+    "grow/leafwise",
+    jax.jit(_grow_tree_fn,
+            static_argnames=("num_leaves", "num_bins_max",
+                             "min_data_in_leaf", "min_sum_hessian_in_leaf",
+                             "max_depth", "hist_backend", "hist_chunk",
+                             "compute_dtype")),
+    phase="grow")
 
 
 def grow_tree_impl(bins: jax.Array, grad: jax.Array, hess: jax.Array,

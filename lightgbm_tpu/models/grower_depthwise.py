@@ -364,8 +364,16 @@ def grow_tree_depthwise(bins: jax.Array, grad: jax.Array, hess: jax.Array,
 
 # Module-level jit so repeated boosters with identical shapes/config share
 # one compiled program (the unrolled level program takes minutes to compile).
-grow_tree_depthwise_jit = jax.jit(
-    grow_tree_depthwise,
-    static_argnames=("num_leaves", "num_bins_max", "min_data_in_leaf",
-                     "min_sum_hessian_in_leaf", "max_depth", "hist_chunk",
-                     "compute_dtype", "hist_axis"))
+# Wrapped in the cost registry (costmodel.instrument): with telemetry armed
+# the compiled program self-reports cost_analysis + compile seconds for the
+# roofline/compile blocks.
+from .. import costmodel as _costmodel  # noqa: E402
+
+grow_tree_depthwise_jit = _costmodel.instrument(
+    "grow/depthwise",
+    jax.jit(grow_tree_depthwise,
+            static_argnames=("num_leaves", "num_bins_max",
+                             "min_data_in_leaf", "min_sum_hessian_in_leaf",
+                             "max_depth", "hist_chunk", "compute_dtype",
+                             "hist_axis")),
+    phase="grow")

@@ -61,7 +61,6 @@ collectives inside the tier switch stay uniform across shards.
 """
 from __future__ import annotations
 
-import functools
 from typing import NamedTuple
 
 import jax
@@ -97,22 +96,18 @@ class _CompactState(NamedTuple):
     done: jax.Array             # bool
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("num_leaves", "num_bins_max", "min_data_in_leaf",
-                     "min_sum_hessian_in_leaf", "max_depth", "hist_backend",
-                     "hist_chunk", "compute_dtype", "use_pallas_partition",
-                     "partition_overlap", "interpret"))
-def grow_tree_leafcompact(bins, grad, hess, row_mask, feature_mask,
-                          num_bins, *, num_leaves: int, num_bins_max: int,
-                          min_data_in_leaf: int,
-                          min_sum_hessian_in_leaf: float,
-                          max_depth: int = -1, hist_backend: str = "matmul",
-                          hist_chunk: int = 16384,
-                          compute_dtype=jnp.float32,
-                          use_pallas_partition: bool = False,
-                          partition_overlap: bool = True,
-                          interpret: bool = False) -> TreeArrays:
+def _grow_tree_leafcompact_fn(bins, grad, hess, row_mask, feature_mask,
+                              num_bins, *, num_leaves: int,
+                              num_bins_max: int,
+                              min_data_in_leaf: int,
+                              min_sum_hessian_in_leaf: float,
+                              max_depth: int = -1,
+                              hist_backend: str = "matmul",
+                              hist_chunk: int = 16384,
+                              compute_dtype=jnp.float32,
+                              use_pallas_partition: bool = False,
+                              partition_overlap: bool = True,
+                              interpret: bool = False) -> TreeArrays:
     return grow_tree_leafcompact_impl(
         bins, grad, hess, row_mask, feature_mask, num_bins,
         num_leaves=num_leaves, num_bins_max=num_bins_max,
@@ -122,6 +117,22 @@ def grow_tree_leafcompact(bins, grad, hess, row_mask, feature_mask,
         hist_chunk=hist_chunk, compute_dtype=compute_dtype,
         use_pallas_partition=use_pallas_partition,
         partition_overlap=partition_overlap, interpret=interpret)
+
+
+# module-level jit wrapped in the cost registry (costmodel.instrument) so
+# the compacted grower's compiled programs self-report cost_analysis +
+# compile seconds to the roofline/compile blocks when telemetry is armed
+from .. import costmodel as _costmodel  # noqa: E402
+
+grow_tree_leafcompact = _costmodel.instrument(
+    "grow/leafcompact",
+    jax.jit(_grow_tree_leafcompact_fn,
+            static_argnames=("num_leaves", "num_bins_max",
+                             "min_data_in_leaf", "min_sum_hessian_in_leaf",
+                             "max_depth", "hist_backend", "hist_chunk",
+                             "compute_dtype", "use_pallas_partition",
+                             "partition_overlap", "interpret")),
+    phase="grow")
 
 
 def grow_tree_leafcompact_impl(bins, grad, hess, row_mask, feature_mask,

@@ -335,26 +335,49 @@ def partition_segment(seg, mask3, delta, cnt, plcnt, *, block: int = BLOCK,
     re-running the python body, so an env flip would be ignored even
     when the OUTER program retraces).
     """
-    from .. import telemetry
+    from .. import costmodel, telemetry
     if use_pallas:
         overlap = overlap and partition_overlap_on()
     telemetry.count("partition/pallas" if use_pallas else "partition/xla")
     if use_pallas:
         telemetry.count("partition/dma_overlap" if overlap
                         else "partition/dma_serial")
+    if costmodel.enabled():
+        # analytic per-pass cost (the Pallas kernel is a custom call XLA
+        # cost analysis cannot see into): the pane is read and written
+        # once per partition pass — plus the selection matmuls' MACs
+        # (R x W x block one-hot contractions; 3 per block overlapped,
+        # 2 serialized)
+        R, W = seg.shape
+        costmodel.note_traced_pass(
+            "partition", ("pane", R, W, block, bool(use_pallas),
+                          bool(overlap)),
+            bytes_moved=2.0 * R * W,
+            macs=float(R) * W * block * (3 if overlap else 2))
     with telemetry.span("partition") as sp:
         return sp.fence(_partition_segment_jit(
             seg, mask3, delta, cnt, plcnt, block=block,
             use_pallas=use_pallas, interpret=interpret, overlap=overlap))
 
 
-@functools.partial(jax.jit, static_argnames=("block", "use_pallas",
-                                             "interpret", "overlap"))
-def _partition_segment_jit(seg, mask3, delta, cnt, plcnt, *, block,
-                           use_pallas, interpret, overlap):
+def _partition_segment_fn(seg, mask3, delta, cnt, plcnt, *, block,
+                          use_pallas, interpret, overlap):
     return _partition_segment_impl(
         seg, mask3, delta, cnt, plcnt, block=block,
         use_pallas=use_pallas, interpret=interpret, overlap=overlap)
+
+
+# jitted + wrapped in the cost registry: standalone (eager) partition
+# calls — tests, micro-benchmarks — self-report compile seconds and
+# memory analysis; under an outer trace the wrapper passes through
+from .. import costmodel as _costmodel_mod  # noqa: E402
+
+_partition_segment_jit = _costmodel_mod.instrument(
+    "partition/kernel",
+    jax.jit(_partition_segment_fn,
+            static_argnames=("block", "use_pallas", "interpret",
+                             "overlap")),
+    phase="partition")
 
 
 def _partition_segment_impl(seg, mask3, delta, cnt, plcnt, *, block,

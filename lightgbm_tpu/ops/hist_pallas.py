@@ -83,11 +83,9 @@ def _hist_kernel(bins_ref, packed_ref, out_ref, *, F, B, chunk, lanes,
             preferred_element_type=acc_dtype)               # [B, LANES]
 
 
-@functools.partial(jax.jit, static_argnames=("B", "chunk", "dtype", "lanes",
-                                             "stats"))
-def hist_pallas_raw(bins, packed, *, B: int, chunk: int = 2048,
-                    dtype: str = "int8", lanes: int = LANES,
-                    stats: int = 3):
+def _hist_pallas_raw_fn(bins, packed, *, B: int, chunk: int = 2048,
+                        dtype: str = "int8", lanes: int = LANES,
+                        stats: int = 3):
     """[F, B, lanes] accumulator from [F, N] bins and packed values.
 
     Rows must be pre-padded to a multiple of ``chunk`` (pad cid with -1).
@@ -158,6 +156,21 @@ def hist_pallas_raw(bins, packed, *, B: int, chunk: int = 2048,
     if dtype in ("int8", "bf16v"):
         return out                       # int32 / f32 accumulator as-is
     return out.astype(jnp.int32)
+
+
+# jitted + wrapped in the cost registry: a STANDALONE (eager) call of the
+# Pallas kernel — micro-benchmarks, tests — self-reports its compile cost
+# and memory analysis; inside a traced grower program the wrapper passes
+# straight through and the kernel inlines as before (cost analysis cannot
+# see into the custom call either way — the analytic MAC counts ride
+# costmodel.note_traced_pass from the histogram routing layer instead)
+from .. import costmodel as _costmodel  # noqa: E402
+
+hist_pallas_raw = _costmodel.instrument(
+    "hist/pallas_raw",
+    jax.jit(_hist_pallas_raw_fn,
+            static_argnames=("B", "chunk", "dtype", "lanes", "stats")),
+    phase="histogram")
 
 
 def feature_block(B: int, lanes: int, budget: int = 12 << 20) -> int:

@@ -24,7 +24,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from .. import telemetry
+from .. import costmodel, telemetry
 
 # transient one-hot working-set budget (bytes) for the chunked matmul
 CHUNK_BYTE_BUDGET = 256 << 20
@@ -55,6 +55,44 @@ def _pallas_hist_ok(num_bins_max: int) -> bool:
     ok = jax.default_backend() == "tpu" and num_bins_max <= 256
     telemetry.count("hist/pallas_eligible" if ok else "hist/pallas_ineligible")
     return ok
+
+
+def dense_pass_cost(N: int, F: int, B: int, num_cols: int):
+    """Analytic cost of ONE leaf-batched histogram pass — the dense
+    one-hot-matmul MAC count PROFILE.md's roofline derives by hand
+    (N x F x B x lanes per group; the MXU tile floor makes <=42 leaf
+    columns cost 128 lanes, 43-64 ride a 192-lane operand) and the HBM
+    bytes streamed (int8 bins + the packed per-row side-band, re-read
+    once per group, + the per-group accumulator write-back).  Wider
+    levels are modeled on the PALLAS grouping rule — balanced groups of
+    <=64 columns (hist_pallas._grouped(group_width=64); the XLA einsum
+    fallback groups by 42, but the analytic note exists for the Pallas
+    routes cost analysis cannot see into).  Filed per traced pass via
+    costmodel.note_traced_pass."""
+    if num_cols <= 42:
+        groups, lanes = 1, 128.0
+    elif num_cols <= 64:
+        groups, lanes = 1, 192.0
+    else:
+        groups = -(-num_cols // 64)
+        width = -(-num_cols // groups)
+        lanes = 128.0 if width <= 42 else 192.0
+    macs = float(N) * F * B * lanes * groups
+    bytes_moved = (groups * (float(N) * F + 4.0 * N)
+                   + groups * float(F) * B * lanes * 4.0)
+    return macs, bytes_moved
+
+
+def _note_hist_pass(bins, num_cols: int, num_bins_max: int,
+                    compute_dtype) -> None:
+    if not costmodel.enabled():
+        return
+    F, N = bins.shape
+    macs, bytes_moved = dense_pass_cost(N, F, num_bins_max, num_cols)
+    dt = getattr(compute_dtype, "__name__", None) or str(compute_dtype)
+    costmodel.note_traced_pass(
+        "histogram", ("pass", N, F, num_bins_max, num_cols, dt),
+        macs=macs, bytes_moved=bytes_moved)
 
 
 def histogram_matmul(bins: jax.Array, grad: jax.Array, hess: jax.Array,
@@ -175,6 +213,7 @@ def histogram_leafbatch(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     -------
     hist : [C, F, B, 3] f32
     """
+    _note_hist_pass(bins, num_cols, num_bins_max, compute_dtype)
     if str(compute_dtype).startswith("int8"):
         # quantized-gradient path: Pallas int8-MXU kernel on TPU, the
         # bit-identical XLA formulation elsewhere (ops/hist_pallas.py).

@@ -485,7 +485,8 @@ class DataParallelLearner(_ParallelLearnerBase):
             return P()
 
         pspecs = jax.tree.map(param_spec, obj_params)
-        prog = jax.jit(shard_map(
+        from .. import costmodel
+        prog = costmodel.instrument("chunk/dp", jax.jit(shard_map(
             shard_chunk, mesh=mesh,
             in_specs=(P(None, DATA_AXIS), P(None, DATA_AXIS), P(),
                       P(DATA_AXIS),
@@ -496,7 +497,8 @@ class DataParallelLearner(_ParallelLearnerBase):
                       P(), P(), P(), P()),
             out_specs=(P(None, DATA_AXIS),
                        tuple(P() for _ in range(n_valid)),
-                       _tree_out_specs(None), P(), P())))
+                       _tree_out_specs(None), P(), P()))),
+            phase="train_chunk")
         _DP_CHUNK_PROGRAMS[key] = prog
         return prog, num_shards
 
@@ -699,11 +701,12 @@ class DataParallelLearner(_ParallelLearnerBase):
                 # segmented path
                 shard_fn = self._grow_fn(kwargs, F, num_shards)
 
-            self._jitted = jax.jit(shard_map(
+            from .. import costmodel
+            self._jitted = costmodel.instrument("grow/dp", jax.jit(shard_map(
                 shard_fn, mesh=mesh,
                 in_specs=(P(None, DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
                           P(DATA_AXIS), P(), P()),
-                out_specs=_tree_out_specs(DATA_AXIS)))
+                out_specs=_tree_out_specs(DATA_AXIS))), phase="grow")
 
         tree = self._jitted(bins, grad, hess, row_mask, feature_mask,
                             gbdt.num_bins_device)
@@ -846,11 +849,13 @@ class FeatureParallelLearner(_ParallelLearnerBase):
                 (row_masks, feat_masks))
             return score, vscores, stacked, mvals, hvals
 
-        prog = jax.jit(shard_map(
+        from .. import costmodel
+        prog = costmodel.instrument("chunk/fp", jax.jit(shard_map(
             shard_chunk, mesh=mesh,
             in_specs=(P(),) * 12,
             out_specs=(P(), tuple(P() for _ in range(n_valid)),
-                       _tree_out_specs(None), P(), P())))
+                       _tree_out_specs(None), P(), P()))),
+            phase="train_chunk")
         _FP_CHUNK_PROGRAMS[key] = prog
         return prog, num_shards
 
@@ -875,10 +880,11 @@ class FeatureParallelLearner(_ParallelLearnerBase):
                 return self._shard_grow_fn(grow, kwargs, own, ownmask)(
                     bins_full, grad_s, hess_s, mask_s, fmask, nbins)
 
-            self._jitted = jax.jit(shard_map(
+            from .. import costmodel
+            self._jitted = costmodel.instrument("grow/fp", jax.jit(shard_map(
                 shard_fn, mesh=mesh,
                 in_specs=(P(),) * 8,
-                out_specs=_tree_out_specs(None)))
+                out_specs=_tree_out_specs(None))), phase="grow")
 
         own, ownmask = self._ownership(gbdt, num_shards)
         tree = self._jitted(bins, grad, hess, row_mask, feature_mask,

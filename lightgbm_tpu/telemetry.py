@@ -70,6 +70,21 @@ ISSUE 2 additions — the device-side observability triad:
    timeline rows line up with ``phase_times`` keys.  Health events (NaN
    counts, saturation, divergence — lightgbm_tpu/health.py) ride the
    iteration records as a ``health`` block via ``emit_iteration``.
+
+ISSUE 4 — roofline attribution and compile observability
+(lightgbm_tpu/costmodel.py rides this registry's lifecycle):
+
+5. **Roofline + compile blocks**: enable()/disable()/reset() arm the
+   compiled-program cost registry alongside the spans, so the summary
+   record and ``snapshot()`` carry a ``roofline`` block (per-phase static
+   flops/bytes from ``compiled.cost_analysis()`` joined to the measured
+   spans → attained FLOP/s, HBM GB/s, fraction-of-peak) and a ``compile``
+   block (program inventory, cold compile seconds, persistent-cache
+   hits, mid-run recompiles).  ``emit_iteration`` watches the
+   backend-compile counter: a compile AFTER the first iteration record
+   is a mid-run recompile — counted (``jit/midrun_recompile``) and
+   warned once, because it means a program cache key failed to capture
+   something that changed.
 """
 from __future__ import annotations
 
@@ -121,6 +136,11 @@ _allhosts_mem_peak: Optional[int] = None
 
 _compile_listener_installed = False
 
+# mid-run recompile watch (ISSUE 4): backend-compile count at the first
+# iteration record; growth past it after that is a mid-run recompile
+_compile_base: "Optional[int]" = None
+_midrun_warned = False
+
 
 # --------------------------------------------------------------- life cycle
 
@@ -155,6 +175,11 @@ def enable(jsonl_path: Optional[str] = None, fence: bool = False,
         _sink_path = jsonl_path
         _sink_error = False
     _install_compile_listener()
+    try:
+        from . import costmodel
+        costmodel.enable()
+    except Exception:
+        pass
 
 
 def disable() -> None:
@@ -170,12 +195,25 @@ def disable() -> None:
             pass
     _sink_file = None
     _sink_path = None
+    try:
+        from . import costmodel
+        costmodel.disable()
+    except Exception:
+        pass
 
 
 def reset() -> None:
     """Zero all counters/timers/gauges (sink and enabled state are
     untouched)."""
     global _mem_peak, _residency, _allhosts_mem_peak, _mem_dev_peak_base
+    global _compile_base, _midrun_warned
+    _compile_base = None
+    _midrun_warned = False
+    try:
+        from . import costmodel
+        costmodel.reset()
+    except Exception:
+        pass
     _counters.clear()
     _phase_times.clear()
     _phase_counts.clear()
@@ -513,9 +551,52 @@ def _install_compile_listener() -> None:
                     _trace_times.get("backend_compile", 0.0) + dur)
 
         monitoring.register_event_duration_secs_listener(_on_duration)
+        # the duration listener is registered: mark installed NOW —
+        # jax.monitoring has no unregister, so a failure in the second
+        # (optional) registration below must not cause a later enable()
+        # to stack a duplicate _on_duration listener
         _compile_listener_installed = True
+
+        def _on_event(name: str, **kw) -> None:
+            # persistent-compilation-cache hits (ISSUE 4): jax records
+            # '/jax/compilation_cache/cache_hits' once per executable
+            # served from the on-disk cache — together with
+            # jit/backend_compile this decomposes "programs built" into
+            # paid-compiles vs cache-served
+            if _enabled and "cache_hit" in name:
+                _counters["jit/persistent_cache_hit"] = (
+                    _counters.get("jit/persistent_cache_hit", 0) + 1)
+
+        try:
+            monitoring.register_event_listener(_on_event)
+        except Exception:
+            pass
     except Exception:
         pass
+
+
+def _watch_midrun_recompiles() -> None:
+    """Called at each iteration record: backend compiles AFTER the first
+    record mean a chunk/grower program cache key missed something that
+    changed mid-run (the exact failure mode the PR-3 cache-key hardening
+    fixed) — count them and warn once."""
+    global _compile_base, _midrun_warned
+    n = _counters.get("jit/backend_compile", 0)
+    if _compile_base is None:
+        _compile_base = n
+        return
+    if n > _compile_base:
+        _counters["jit/midrun_recompile"] = (
+            _counters.get("jit/midrun_recompile", 0) + (n - _compile_base))
+        _compile_base = n
+        if not _midrun_warned:
+            _midrun_warned = True
+            from .utils import log
+            log.warning(
+                "telemetry: %d backend compile(s) happened after the first "
+                "iteration record (mid-run recompile) — a program cache "
+                "key may not capture everything that changed"
+                % _counters["jit/midrun_recompile"])
 
 
 # ---------------------------------------------------------------- snapshots
@@ -531,7 +612,24 @@ def snapshot() -> dict:
     mem = memory_snapshot()
     if mem is not None:
         out["memory"] = mem
+    _attach_cost_blocks(out)
     return out
+
+
+def _attach_cost_blocks(record: dict) -> None:
+    """Add the ``roofline`` and ``compile`` blocks (costmodel registry
+    joined to the cumulative phase spans) to a summary-shaped record.
+    Absent entirely while the cost registry has nothing — disabled-mode
+    snapshots stay empty — and never raises (reporting must not crash
+    training)."""
+    try:
+        from . import costmodel
+        if costmodel.active():
+            record["roofline"] = costmodel.roofline(dict(_phase_times),
+                                                    fenced=_fence)
+            record["compile"] = costmodel.compile_block()
+    except Exception:
+        pass
 
 
 def take_phase_deltas() -> "tuple[Dict[str, float], Dict[str, float]]":
@@ -614,6 +712,7 @@ def emit_iteration(iteration: int, phase_times: Dict[str, float],
     iteration's training-health block (lightgbm_tpu/health.py),
     ``memory`` the per-iteration gauge block (take_memory_record).
     Returns the record."""
+    _watch_midrun_recompiles()
     pt = {k: 0.0 for k in CANONICAL_PHASES}
     pt.update(phase_times)
     record = {
@@ -648,6 +747,7 @@ def emit_summary(extra: Optional[dict] = None) -> dict:
     mem = memory_snapshot()
     if mem is not None:
         record["memory"] = mem
+    _attach_cost_blocks(record)
     if extra:
         record.update(extra)
     write_record(record)

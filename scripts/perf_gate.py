@@ -1,0 +1,264 @@
+"""Perf-regression gate over the BENCH/MULTICHIP round trajectory.
+
+Reads the repo's bench history (``BENCH_r*.json`` wrappers with a
+``parsed`` bench record, raw ``bench.py`` JSON lines, and
+``MULTICHIP_r*.json`` smoke records) and flags regressions in the LATEST
+round against the earlier trajectory:
+
+- **throughput**: the headline ``value`` and the satellite rate keys
+  (parity/leafwise_int8/maxbin63 rows, ``vs_cuda``) must not drop below
+  the prior median by more than the recorded noise band — the
+  ``spread``/``parity_spread``-style (max-min)/median markers bench.py
+  records for exactly this purpose (sigma = band/2; flagged beyond
+  ``--sigma-mult`` sigmas, default 3);
+- **attained fraction**: the roofline block's ``frac_of_peak_flops`` /
+  ``frac_of_peak_bw`` per phase, when present — a throughput number can
+  hide a kernel regression behind a faster host, the attained fraction
+  cannot;
+- **multichip**: a round whose smoke run went ok -> not-ok.
+
+Entries are grouped by their ``metric`` name (an 11M round is never
+compared to a 1M round) and, when the ``host`` block is present
+(bench.py records device_kind/jax versions/git SHA since ISSUE 4), the
+gate REFUSES to compare rounds measured on different device kinds
+(exit 2) — cross-hardware "regressions" are noise.  Rounds without a
+host block (the pre-ISSUE-4 history) are assumed comparable.
+
+Usage (the documented pre-merge check):
+
+    python scripts/perf_gate.py --check 'BENCH_r*.json' 'MULTICHIP_r*.json'
+
+Exit codes: 0 = no regression, 1 = regression flagged, 2 = bad input /
+cross-hardware mix.  ``--json`` prints the machine-readable report.
+Runs as a tier-1 unit test (tests/test_perf_gate.py: must flag an
+injected 3-sigma regression, must pass the real r01+ trajectory).
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+# satellite rate keys checked next to the headline "value", with the
+# spread key that prices their noise band
+RATE_KEYS: Tuple[Tuple[str, str], ...] = (
+    ("value", "spread"),
+    ("vs_cuda", "spread"),
+    ("parity_leafwise_f32_iters_per_sec", "parity_spread"),
+    ("leafwise_int8_iters_per_sec", "leafwise_int8_spread"),
+    ("maxbin63_iters_per_sec", "maxbin63_spread"),
+)
+
+DEFAULT_FLOOR = 0.02      # minimum relative noise band when none recorded
+DEFAULT_SIGMA_MULT = 3.0
+
+
+class GateError(Exception):
+    """Malformed input or an invalid comparison (exit code 2)."""
+
+
+def _round_of(path: str, data: dict) -> int:
+    n = data.get("n") or data.get("round")
+    if isinstance(n, int):
+        return n
+    m = re.search(r"r(\d+)", os.path.basename(path))
+    return int(m.group(1)) if m else 0
+
+
+def load_entry(path: str) -> dict:
+    """One trajectory entry: {kind: bench|multichip, round, rec, path}."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError) as e:
+        raise GateError(f"{path}: unreadable bench JSON ({e})")
+    if not isinstance(data, dict):
+        raise GateError(f"{path}: expected a JSON object")
+    if isinstance(data.get("parsed"), dict):
+        rec, kind = data["parsed"], "bench"
+    elif "metric" in data:
+        rec, kind = data, "bench"
+    elif "n_devices" in data or "ok" in data:
+        rec, kind = data, "multichip"
+    else:
+        raise GateError(f"{path}: unrecognized bench record "
+                        "(no 'parsed', 'metric' or multichip keys)")
+    return {"kind": kind, "round": _round_of(path, data), "rec": rec,
+            "path": path}
+
+
+def _fractions(rec: dict) -> Dict[str, float]:
+    """Flatten the roofline attained fractions into gate keys."""
+    out = {}
+    phases = (rec.get("roofline") or {}).get("phases") or {}
+    for phase, blk in phases.items():
+        for f in ("frac_of_peak_flops", "frac_of_peak_bw"):
+            v = blk.get(f)
+            if isinstance(v, (int, float)):
+                out[f"roofline/{phase}/{f}"] = float(v)
+    return out
+
+
+def _series(entries: List[dict], key: str) -> List[Tuple[int, float]]:
+    out = []
+    for e in entries:
+        v = e["rec"].get(key)
+        if key.startswith("roofline/"):
+            v = _fractions(e["rec"]).get(key)
+        if isinstance(v, (int, float)):
+            out.append((e["round"], float(v)))
+    return out
+
+
+def _median(vals: List[float]) -> float:
+    s = sorted(vals)
+    n = len(s)
+    return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+
+def _noise_band(entries: List[dict], spread_key: str, floor: float) -> float:
+    """Noise band from the PRIOR rounds only (callers pass entries[:-1]):
+    a regressed round must not widen its own allowance by also reporting
+    a wide spread (self-masking)."""
+    spreads = [float(e["rec"][spread_key]) for e in entries
+               if isinstance(e["rec"].get(spread_key), (int, float))]
+    return max(spreads + [floor])
+
+
+def _check_group(metric: str, entries: List[dict], floor: float,
+                 sigma_mult: float, allow_cross_hardware: bool,
+                 findings: List[dict]) -> None:
+    entries = sorted(entries, key=lambda e: e["round"])
+    kinds = {e["rec"].get("host", {}).get("device_kind")
+             for e in entries if isinstance(e["rec"].get("host"), dict)}
+    kinds.discard(None)
+    if len(kinds) > 1 and not allow_cross_hardware:
+        raise GateError(
+            f"{metric}: trajectory mixes device kinds {sorted(kinds)} — "
+            "cross-hardware comparisons refused "
+            "(--allow-cross-hardware to override)")
+    if len(entries) < 2:
+        return
+    latest_round = entries[-1]["round"]
+    keys = [k for k, _ in RATE_KEYS]
+    keys += sorted({k for e in entries for k in _fractions(e["rec"])})
+    spread_of = dict(RATE_KEYS)
+    for key in keys:
+        series = _series(entries, key)
+        if len(series) < 2 or series[-1][0] != latest_round:
+            continue
+        prior = [v for r, v in series[:-1]]
+        latest = series[-1][1]
+        baseline = _median(prior)
+        if baseline <= 0:
+            continue
+        band = _noise_band(entries[:-1], spread_of.get(key, "spread"),
+                           floor)
+        sigma = band / 2.0
+        threshold = baseline * (1.0 - sigma_mult * sigma)
+        if latest < threshold:
+            findings.append({
+                "metric": metric, "key": key,
+                "latest_round": latest_round,
+                "latest": latest, "baseline": round(baseline, 6),
+                "drop": round(1.0 - latest / baseline, 4),
+                "allowed_drop": round(sigma_mult * sigma, 4),
+            })
+
+
+def _check_multichip(entries: List[dict], findings: List[dict]) -> None:
+    entries = sorted(entries, key=lambda e: e["round"])
+    if len(entries) < 2:
+        return
+    latest = entries[-1]
+    if not latest["rec"].get("ok", False) and any(
+            e["rec"].get("ok") for e in entries[:-1]):
+        findings.append({
+            "metric": "multichip", "key": "ok",
+            "latest_round": latest["round"],
+            "latest": False, "baseline": True,
+            "detail": "multichip smoke went ok -> not-ok",
+        })
+
+
+def check_files(paths: List[str], floor: float = DEFAULT_FLOOR,
+                sigma_mult: float = DEFAULT_SIGMA_MULT,
+                allow_cross_hardware: bool = False) -> dict:
+    """Gate a trajectory; returns the report dict (``findings`` empty on
+    a clean pass).  Raises GateError on malformed/uncomparable input."""
+    if not paths:
+        raise GateError("no bench history files matched")
+    entries = [load_entry(p) for p in paths]
+    groups: Dict[str, List[dict]] = {}
+    multichip: List[dict] = []
+    for e in entries:
+        if e["kind"] == "multichip":
+            multichip.append(e)
+        else:
+            groups.setdefault(str(e["rec"].get("metric", "?")),
+                              []).append(e)
+    findings: List[dict] = []
+    for metric, group in sorted(groups.items()):
+        _check_group(metric, group, floor, sigma_mult,
+                     allow_cross_hardware, findings)
+    _check_multichip(multichip, findings)
+    return {
+        "files": len(entries),
+        "groups": {m: len(g) for m, g in sorted(groups.items())},
+        "multichip_rounds": len(multichip),
+        "sigma_mult": sigma_mult, "floor": floor,
+        "findings": findings,
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--check", nargs="+", metavar="GLOB", required=True,
+                   help="bench history globs, e.g. 'BENCH_r*.json' "
+                        "'MULTICHIP_r*.json'")
+    p.add_argument("--floor", type=float, default=DEFAULT_FLOOR,
+                   help="minimum relative noise band when no spread is "
+                        "recorded (default %(default)s)")
+    p.add_argument("--sigma-mult", type=float, default=DEFAULT_SIGMA_MULT,
+                   help="flag drops beyond this many sigmas "
+                        "(sigma = band/2; default %(default)s)")
+    p.add_argument("--allow-cross-hardware", action="store_true",
+                   help="compare rounds across device kinds anyway")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable report")
+    args = p.parse_args(argv)
+    paths = sorted({f for g in args.check for f in glob.glob(g)})
+    try:
+        report = check_files(paths, floor=args.floor,
+                             sigma_mult=args.sigma_mult,
+                             allow_cross_hardware=args.allow_cross_hardware)
+    except GateError as e:
+        print(f"perf_gate error: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(report))
+    else:
+        for f in report["findings"]:
+            if "drop" in f:
+                print("REGRESSION %s %s: round %s at %.4g, %.1f%% below "
+                      "the prior median %.4g (allowed %.1f%%)"
+                      % (f["metric"], f["key"], f["latest_round"],
+                         f["latest"], 100 * f["drop"], f["baseline"],
+                         100 * f["allowed_drop"]))
+            else:
+                print("REGRESSION %s %s: %s"
+                      % (f["metric"], f["key"],
+                         f.get("detail", "regressed")))
+        if not report["findings"]:
+            print("perf_gate: %d file(s), %d metric group(s) — no "
+                  "regression beyond the noise bands"
+                  % (report["files"], len(report["groups"])))
+    return 1 if report["findings"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,7 +17,15 @@ trailing {"summary": true, ...} record) and prints:
     saturation totals, iterations with anomalies, score watermark),
   - the memory table (ISSUE 2 ``memory`` blocks: peak bytes_in_use,
     per-phase byte deltas, the dataset-residency report),
+  - the roofline table (ISSUE 4 ``roofline`` block: per-phase static
+    flops/bytes joined to measured seconds — attained FLOP/s, HBM GB/s,
+    fraction-of-peak when the device kind is known) and the compile
+    table (program inventory, compile seconds, cache hits, mid-run
+    recompiles),
   - first/last eval metric values per dataset/metric.
+
+Malformed or truncated JSONL exits with a one-line error (code 2), not a
+stack trace — half-written sinks from crashed runs are an expected input.
 """
 from __future__ import annotations
 
@@ -26,14 +34,30 @@ import json
 import sys
 
 
+class MalformedJSONL(Exception):
+    pass
+
+
 def load(path: str):
     iters, summary, residency = [], None, None
-    with open(path) as f:
-        for line in f:
+    try:
+        f = open(path)
+    except OSError as e:
+        raise MalformedJSONL(f"cannot read {path}: {e}")
+    with f:
+        for lineno, line in enumerate(f, 1):
             line = line.strip()
             if not line:
                 continue
-            rec = json.loads(line)
+            try:
+                rec = json.loads(line)
+            except ValueError as e:
+                raise MalformedJSONL(
+                    f"{path}:{lineno}: malformed JSONL record ({e}) — "
+                    "truncated sink from an aborted run?")
+            if not isinstance(rec, dict):
+                raise MalformedJSONL(
+                    f"{path}:{lineno}: record is not a JSON object")
             if rec.get("summary"):
                 summary = rec
             elif "iter" in rec:
@@ -93,8 +117,96 @@ def _table(title, totals, n_iters):
     return lines
 
 
+def _roofline_lines(roofline):
+    out = ["Roofline (static costs x measured spans)",
+           "---------------------------------------"]
+    if not roofline:
+        out.append("(no roofline block — emitted by metrics_out= runs "
+                   "since ISSUE 4)")
+        return out
+    peaks = roofline.get("peaks")
+    out.append("device_kind: %s   peaks: %s"
+               % (roofline.get("device_kind", "?"),
+                  ("unavailable" if peaks in (None, "unavailable")
+                   else ", ".join("%s=%.3g" % kv
+                                  for kv in sorted(peaks.items())))))
+    phases = roofline.get("phases") or {}
+    if phases:
+        width = max(len(k) for k in phases)
+        out.append(f"{'phase'.ljust(width)}  {'GFLOP':>10}  {'GB':>8}  "
+                   f"{'sec':>8}  {'GFLOP/s':>9}  {'GB/s':>7}  "
+                   f"{'%peak':>6}  {'AI':>7}")
+        for k, b in sorted(phases.items()):
+            frac = b.get("frac_of_peak_flops")
+            out.append(
+                f"{k.ljust(width)}  {b.get('flops', 0) / 1e9:>10.3f}  "
+                f"{b.get('bytes_accessed', 0) / 1e9:>8.3f}  "
+                f"{b.get('seconds', 0):>8.3f}  "
+                + ("%9.2f" % (b["attained_flops_per_sec"] / 1e9)
+                   if "attained_flops_per_sec" in b else "%9s" % "-") + "  "
+                + ("%7.2f" % b["attained_hbm_gbps"]
+                   if "attained_hbm_gbps" in b else "%7s" % "-") + "  "
+                + ("%5.1f%%" % (100 * frac) if frac is not None
+                   else "%6s" % "-") + "  "
+                + ("%7.3f" % b["arithmetic_intensity"]
+                   if "arithmetic_intensity" in b else "%7s" % "-"))
+    else:
+        out.append("(no phases captured)")
+    passes = roofline.get("traced_passes") or []
+    if passes:
+        out.append("analytic traced passes (Pallas/custom-call costs XLA "
+                   "analysis cannot see):")
+        for n in passes:
+            out.append("  %-10s %-42s traces=%-3d TMAC/pass=%.4g"
+                       % (n.get("phase", "?"), str(n.get("key")),
+                          n.get("traces", 0), n.get("macs", 0.0) / 1e12))
+    return out
+
+
+def _compile_lines(comp):
+    out = ["Compile observability", "---------------------"]
+    if not comp:
+        out.append("(no compile block — emitted by metrics_out= runs "
+                   "since ISSUE 4)")
+        return out
+    out.append("programs captured  %d  (cold compile %.2f s, %d warm)"
+               % (comp.get("program_count", 0),
+                  comp.get("total_compile_seconds", 0.0),
+                  comp.get("warm_programs", 0)))
+    out.append("backend compiles   %d   persistent-cache hits %d   "
+               "MID-RUN recompiles %d%s"
+               % (comp.get("backend_compiles", 0),
+                  comp.get("persistent_cache_hits", 0),
+                  comp.get("midrun_recompiles", 0),
+                  "  <-- cache-key leak?"
+                  if comp.get("midrun_recompiles", 0) else ""))
+    progs = comp.get("programs") or []
+    if progs:
+        width = max(len(p.get("name", "?")) for p in progs)
+        out.append(f"{'program'.ljust(width)}  {'compile s':>9}  "
+                   f"{'calls':>5}  {'GFLOP':>9}  {'MB acc':>8}")
+        for p in progs:
+            fl = p.get("flops")
+            by = p.get("bytes_accessed")
+            out.append(
+                f"{p.get('name', '?').ljust(width)}  "
+                f"{p.get('compile_seconds', 0.0):>9.2f}  "
+                f"{p.get('calls', 0):>5d}  "
+                + ("%9.3f" % (fl / 1e9) if fl is not None
+                   else "%9s" % "-") + "  "
+                + ("%8.1f" % (by / 1e6) if by is not None
+                   else "%8s" % "-")
+                + ("  [warm]" if p.get("warm") else "")
+                + ("  [%s]" % p["error"] if p.get("error") else ""))
+    return out
+
+
 def report(path: str, as_json: bool = False) -> int:
-    iters, summary, residency = load(path)
+    try:
+        iters, summary, residency = load(path)
+    except MalformedJSONL as e:
+        print(f"telemetry_report error: {e}", file=sys.stderr)
+        return 2
     if not iters and summary is None:
         print(f"no telemetry records in {path}", file=sys.stderr)
         return 1
@@ -112,6 +224,9 @@ def report(path: str, as_json: bool = False) -> int:
         for k, v in rec.get("eval_metrics", {}).items():
             evals.setdefault(k, []).append(v)
 
+    roofline = (summary or {}).get("roofline")
+    comp = (summary or {}).get("compile")
+
     if as_json:
         print(json.dumps({
             "iterations": n,
@@ -123,6 +238,8 @@ def report(path: str, as_json: bool = False) -> int:
             "health": dict(sorted(health.items())),
             "memory": mem,
             "residency": residency or {},
+            "roofline": roofline or {},
+            "compile": comp or {},
             "eval_first_last": {k: [v[0], v[-1]]
                                 for k, v in sorted(evals.items())},
         }))
@@ -180,6 +297,10 @@ def report(path: str, as_json: bool = False) -> int:
         for k, v in residency.items():
             val = _fmt_bytes(v) if k.endswith("_bytes") else str(v)
             out.append(f"  {k.ljust(width)}  {val:>12}")
+    out.append("")
+    out += _roofline_lines(roofline)
+    out.append("")
+    out += _compile_lines(comp)
     if evals:
         out.append("")
         out.append("Eval metrics (first -> last)")

@@ -1,0 +1,370 @@
+"""Cost-model / roofline-attribution tests (ISSUE 4): program capture
+through the AOT path, graceful degradation on backends with partial or
+absent analyses, the peak-table fallback for unknown device kinds, the
+roofline/compile JSONL schema, crash-flush, and the tier-1 invariant that
+the cost model never perturbs training numerics."""
+import json
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import costmodel, telemetry
+from lightgbm_tpu.io.dataset import Dataset
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    telemetry.disable()
+    telemetry.reset()
+    yield
+    telemetry.disable()
+    telemetry.reset()
+
+
+def _data(n=1100, seed=0, features=6):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, features)
+    y = (x[:, 0] + 0.5 * x[:, 1] + 0.1 * rng.randn(n) > 0).astype(np.float32)
+    return x, y
+
+
+BASE = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 20,
+        "min_sum_hessian_in_leaf": 1.0, "learning_rate": 0.2}
+
+
+# ----------------------------------------------------------- program capture
+
+def test_instrument_captures_cost_and_serves_compiled():
+    """First armed call of a signature AOT-compiles and records the
+    backend's cost/memory analysis; later calls serve the cached
+    executable with identical results and count invocations."""
+    calls = {"n": 0}
+
+    def f(a, b, *, k=1):
+        calls["n"] += 1
+        return (a @ b) * k
+
+    wrapped = costmodel.instrument("test/prog", jax.jit(
+        f, static_argnames=("k",)), phase="test_phase")
+    a = jnp.ones((32, 32))
+    costmodel.enable()
+    out1 = wrapped(a, a, k=3)
+    out2 = wrapped(a, a, k=3)
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+    comp = costmodel.compile_block()
+    assert comp["program_count"] == 1
+    prog = comp["programs"][0]
+    assert prog["name"] == "test/prog" and prog["calls"] == 2
+    assert prog["compile_seconds"] >= 0.0
+    # the CPU backend provides flops/bytes; either way the fields exist
+    # without error (graceful degradation is the contract, not a value)
+    assert "flops" not in prog or prog["flops"] >= 0.0
+    # plain jit path would re-trace per call; AOT traced exactly once
+    assert calls["n"] == 1
+    # numerics match the un-instrumented jit
+    np.testing.assert_array_equal(
+        np.asarray(out1), np.asarray((a @ a) * 3))
+
+
+def test_instrument_disabled_is_passthrough():
+    """Disarmed and capture-free, the wrapper is a straight call into the
+    inner jit: nothing recorded, nothing compiled through AOT."""
+    wrapped = costmodel.instrument("test/off", jax.jit(lambda x: x + 1))
+    out = wrapped(jnp.arange(4))
+    np.testing.assert_array_equal(np.asarray(out), np.arange(4) + 1)
+    assert costmodel.compile_block()["program_count"] == 0
+    assert not costmodel.active()
+
+
+def test_capture_failure_degrades_to_plain_call():
+    """A function without a .lower (or whose lowering fails) still runs —
+    capture failure is recorded, never raised."""
+    wrapped = costmodel.instrument("test/broken", lambda x: x * 2)
+    costmodel.enable()
+    assert wrapped(3) == 6
+    comp = costmodel.compile_block()
+    assert comp["program_count"] == 1
+    assert comp["programs"][0]["error"]
+
+
+def test_analyze_partial_cost_analysis():
+    """Backends returning None / empty / throwing cost analyses yield
+    None fields, not errors (the CPU degradation contract)."""
+    class NoAnalysis:
+        def cost_analysis(self):
+            raise NotImplementedError
+
+        def memory_analysis(self):
+            return None
+
+    class PartialAnalysis:
+        def cost_analysis(self):
+            return [{"flops": 12.0}]      # no "bytes accessed"
+
+        def memory_analysis(self):
+            raise RuntimeError("nope")
+
+    a = costmodel._analyze(NoAnalysis())
+    assert a["flops"] is None and a["bytes_accessed"] is None
+    assert a["memory"] is None
+    b = costmodel._analyze(PartialAnalysis())
+    assert b["flops"] == 12.0 and b["bytes_accessed"] is None
+
+
+# ------------------------------------------------------------------ peak table
+
+def test_unknown_device_kind_degrades_to_peaks_unavailable():
+    assert costmodel.resolve_peaks("banana9000") is None
+    assert costmodel.resolve_peaks("") is None
+    assert costmodel.resolve_peaks("cpu") is None
+    block = costmodel.roofline({"grow": 1.0}, kind="banana9000")
+    assert block["peaks"] == "unavailable"
+    for blk in block["phases"].values():
+        assert "frac_of_peak_flops" not in blk
+
+
+def test_known_device_kinds_resolve():
+    for kind in ("TPU v5 lite", "TPU v5e", "TPU v5p", "TPU v4", "tpu v6e"):
+        peaks = costmodel.resolve_peaks(kind)
+        assert peaks and peaks["flops_per_sec"] > 0
+        assert peaks["hbm_bytes_per_sec"] > 0
+
+
+def test_roofline_join_computes_fractions_on_known_kind():
+    """Static cost x calls joined to measured seconds: attained rates and
+    fraction-of-peak on a (simulated) v5e."""
+    costmodel.enable()
+    costmodel._records.append({
+        "name": "x", "phase": "grow", "compile_seconds": 0.1,
+        "flops": 197e10, "bytes_accessed": 819e7, "memory": None,
+        "calls": 10, "warm": False, "gen": costmodel._generation})
+    block = costmodel.roofline({"grow": 1.0}, kind="TPU v5 lite")
+    g = block["phases"]["grow"]
+    # 10 calls x 197e10 flops over 1s = 10% of the 197e12 peak
+    assert g["frac_of_peak_flops"] == pytest.approx(0.1)
+    assert g["frac_of_peak_bw"] == pytest.approx(0.1)
+    assert g["arithmetic_intensity"] == pytest.approx(197e10 / 819e7)
+    assert g["attained_flops_per_sec"] == pytest.approx(197e11)
+
+
+def test_roofline_excludes_in_span_capture_compile_time():
+    """The first armed call's AOT compile runs inside the caller's phase
+    span: attained rates must price execution seconds only, or a cold
+    compile cache would read as a kernel regression downstream
+    (perf_gate)."""
+    costmodel.enable()
+    costmodel._records.append({
+        "name": "x", "phase": "grow", "compile_seconds": 0.5,
+        "capture_seconds": 0.5, "flops": 1e9, "bytes_accessed": 1e6,
+        "memory": None, "calls": 1, "warm": False,
+        "gen": costmodel._generation})
+    blk = costmodel.roofline({"grow": 1.5},
+                             kind="TPU v5 lite")["phases"]["grow"]
+    assert blk["compile_seconds_excluded"] == 0.5
+    assert blk["seconds"] == 1.5
+    # 1e9 flops over (1.5 - 0.5) execution seconds
+    assert blk["attained_flops_per_sec"] == pytest.approx(1e9)
+    # span shorter than the capture (tiny run): no attained fields rather
+    # than a nonsense rate
+    blk2 = costmodel.roofline({"grow": 0.3},
+                              kind="TPU v5 lite")["phases"]["grow"]
+    assert "attained_flops_per_sec" not in blk2
+
+
+# ------------------------------------------------------------- JSONL schema
+
+def _roofline_schema(block):
+    assert "device_kind" in block
+    assert block["peaks"] == "unavailable" or isinstance(block["peaks"],
+                                                         dict)
+    assert isinstance(block["phases"], dict)
+    for blk in block["phases"].values():
+        for key in ("flops", "bytes_accessed", "programs", "calls",
+                    "seconds"):
+            assert key in blk
+
+
+def _compile_schema(block):
+    for key in ("program_count", "total_compile_seconds", "warm_programs",
+                "backend_compiles", "persistent_cache_hits",
+                "midrun_recompiles", "programs"):
+        assert key in block
+    for p in block["programs"]:
+        assert p["name"] and p["phase"] and p["calls"] >= 1
+
+
+def test_metrics_out_chunked_run_emits_roofline_and_compile(tmp_path):
+    """A metrics_out= run on the CPU backend: the summary carries both
+    blocks — fraction fields degraded (peaks unavailable), the chunk
+    program captured with calls counted."""
+    x, y = _data(n=1210, features=7)
+    ds = Dataset.from_arrays(x, y, max_bin=32)
+    path = str(tmp_path / "m.jsonl")
+    lgb.train(dict(BASE, num_iterations=10, grow_policy="depthwise",
+                   metrics_out=path), ds)
+    telemetry.disable()
+    recs = [json.loads(line) for line in open(path)]
+    summary = recs[-1]
+    assert summary.get("summary") is True
+    _roofline_schema(summary["roofline"])
+    assert summary["roofline"]["peaks"] == "unavailable"  # CPU backend
+    tc = summary["roofline"]["phases"]["train_chunk"]
+    assert tc["calls"] >= 1 and tc["seconds"] > 0
+    assert "attained_flops_per_sec" in tc
+    _compile_schema(summary["compile"])
+    names = [p["name"] for p in summary["compile"]["programs"]]
+    assert "chunk/serial" in names
+    # the analytic histogram pass notes rode along
+    passes = summary["roofline"].get("traced_passes", [])
+    assert any(n["phase"] == "histogram" and n["macs"] > 0 for n in passes)
+
+
+def test_metrics_out_leafwise_run_emits_grow_program(tmp_path):
+    x, y = _data(n=1490, features=5)
+    ds = Dataset.from_arrays(x, y, max_bin=24)
+    path = str(tmp_path / "m.jsonl")
+    lgb.train(dict(BASE, num_iterations=3, num_leaves=11,
+                   metrics_out=path), ds)
+    telemetry.disable()
+    summary = [json.loads(line) for line in open(path)][-1]
+    names = [p["name"] for p in summary["compile"]["programs"]]
+    assert "grow/leafwise" in names
+    grow = summary["roofline"]["phases"]["grow"]
+    assert grow["calls"] == 3
+
+
+def test_snapshot_carries_blocks_and_disabled_mode_stays_empty():
+    snap = telemetry.snapshot()
+    assert "roofline" not in snap and "compile" not in snap
+    telemetry.enable()
+    wrapped = costmodel.instrument("test/snap", jax.jit(lambda x: x * 2))
+    wrapped(jnp.arange(8))
+    snap = telemetry.snapshot()
+    _roofline_schema(snap["roofline"])
+    _compile_schema(snap["compile"])
+
+
+# ----------------------------------------------------------------- crash flush
+
+def test_crash_flush_writes_summary_on_halt(tmp_path):
+    """An exception escaping run_training (TrainingHealthError halt here)
+    writes a final summary record marked ``aborted`` and flushes the sink
+    before re-raising — an aborted run keeps its tail records."""
+    from lightgbm_tpu.config import OverallConfig
+    from lightgbm_tpu.health import TrainingHealthError
+    from lightgbm_tpu.models.gbdt import GBDT
+    from test_health import _NaNObjective
+
+    x, y = _data()
+    ds = Dataset.from_arrays(x, y, max_bin=32)
+    path = str(tmp_path / "m.jsonl")
+    telemetry.enable(path)
+    cfg = OverallConfig()
+    cfg.set(dict({k: str(v) for k, v in BASE.items()},
+                 objective="regression", health="true",
+                 on_anomaly="halt"), require_data=False)
+    booster = GBDT()
+    booster.init(cfg.boosting_config, ds, _NaNObjective())
+    with pytest.raises(TrainingHealthError):
+        booster.run_training(3, False)
+    telemetry.disable()
+    recs = [json.loads(line) for line in open(path)]
+    summary = recs[-1]
+    assert summary.get("summary") is True
+    assert summary["aborted"] == "TrainingHealthError"
+    _roofline_schema(summary["roofline"])
+    _compile_schema(summary["compile"])
+
+
+def test_generic_exception_also_crash_flushes(tmp_path, monkeypatch):
+    """Not just health halts: any exception out of the loop flushes."""
+    x, y = _data()
+    ds = Dataset.from_arrays(x, y, max_bin=32)
+    path = str(tmp_path / "m.jsonl")
+
+    class Boom(RuntimeError):
+        pass
+
+    from lightgbm_tpu.models.gbdt import GBDT
+    orig = GBDT.train_one_iter
+
+    def boom(self, is_eval=True):
+        if self.iter >= 1:
+            raise Boom("mid-train failure")
+        return orig(self, is_eval=is_eval)
+
+    monkeypatch.setattr(GBDT, "train_one_iter", boom)
+    with pytest.raises(Boom):
+        lgb.train(dict(BASE, num_iterations=4, metrics_out=path), ds)
+    telemetry.disable()
+    recs = [json.loads(line) for line in open(path)]
+    assert recs[-1].get("summary") is True
+    assert recs[-1]["aborted"] == "Boom"
+    # the completed iteration's record is in the file too
+    assert any(r.get("iter") == 1 for r in recs)
+
+
+# -------------------------------------------------- numerics non-perturbation
+
+def test_scores_bit_identical_costmodel_on_vs_off():
+    """Tier-1 invariant: routing programs through the AOT capture path
+    must not change numerics — same HLO, same compile options, so scores
+    are bit-identical with the cost model enabled vs disabled."""
+    x, y = _data(seed=3)
+    params = dict(BASE, num_iterations=4, bagging_fraction=0.7,
+                  bagging_freq=1)
+
+    def scores(with_costmodel):
+        telemetry.disable()
+        telemetry.reset()
+        if with_costmodel:
+            costmodel.enable()
+        ds = Dataset.from_arrays(x, y, max_bin=32)
+        booster = lgb.train(params, ds)
+        out = np.asarray(booster.score)
+        costmodel.disable()
+        return out
+
+    off = scores(False)
+    on = scores(True)
+    np.testing.assert_array_equal(off, on)
+
+
+def test_telemetry_report_renders_blocks_and_rejects_malformed(tmp_path,
+                                                               capsys):
+    """scripts/telemetry_report.py renders the roofline/compile tables
+    from a real sink and exits with a one-line error (code 2), not a
+    stack trace, on truncated JSONL."""
+    import os
+    import sys as _sys
+    _sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from scripts import telemetry_report
+
+    x, y = _data(n=1010, features=5)
+    ds = Dataset.from_arrays(x, y, max_bin=16)
+    path = str(tmp_path / "m.jsonl")
+    lgb.train(dict(BASE, num_iterations=2, num_leaves=7,
+                   metrics_out=path), ds)
+    telemetry.disable()
+    assert telemetry_report.report(path) == 0
+    out = capsys.readouterr().out
+    assert "Roofline" in out and "Compile observability" in out
+    assert "peaks: unavailable" in out
+
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text('{"iter": 1, "phase_times"')
+    assert telemetry_report.report(str(bad)) == 2
+    err = capsys.readouterr().err
+    assert "malformed" in err and "Traceback" not in err
+
+
+def test_host_fingerprint_is_self_describing():
+    fp = costmodel.host_fingerprint()
+    assert fp["device_kind"]
+    assert fp["backend"] == jax.default_backend()
+    assert fp["jax_version"] == jax.__version__
+    assert fp["process_count"] == 1

@@ -1,0 +1,151 @@
+"""Perf-regression gate tests (ISSUE 4, tier-1): scripts/perf_gate.py must
+flag an injected 3-sigma throughput/attained-fraction regression in a
+synthetic bench history, pass the repo's REAL BENCH_r*/MULTICHIP_r*
+trajectory, refuse cross-hardware comparisons, and fail cleanly on
+malformed files."""
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from scripts import perf_gate  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write_round(tmp_path, n, value, spread=0.02, metric="iters_11m",
+                 host=None, extra=None):
+    rec = {"metric": metric, "value": value, "unit": "iters/sec",
+           "spread": spread}
+    if host is not None:
+        rec["host"] = host
+    if extra:
+        rec.update(extra)
+    path = tmp_path / f"BENCH_r{n:02d}.json"
+    path.write_text(json.dumps({"n": n, "rc": 0, "parsed": rec}))
+    return str(path)
+
+
+def _history(tmp_path, values, **kw):
+    return [_write_round(tmp_path, i + 1, v, **kw)
+            for i, v in enumerate(values)]
+
+
+# ------------------------------------------------------------ synthetic gate
+
+def test_flags_injected_3sigma_regression(tmp_path):
+    """Noise band 0.02 (recorded spread) -> sigma 1%, 3-sigma allowance
+    3%: a 13% drop in the latest round must be flagged."""
+    paths = _history(tmp_path, [1.67, 1.672, 1.669, 1.671, 1.45])
+    report = perf_gate.check_files(paths)
+    assert report["findings"], "injected regression not flagged"
+    f = report["findings"][0]
+    assert f["key"] == "value" and f["latest_round"] == 5
+    assert f["drop"] > f["allowed_drop"]
+    # CLI surface: exit code 1
+    assert perf_gate.main(["--check", str(tmp_path / "BENCH_r*.json")]) == 1
+
+
+def test_regressed_round_cannot_widen_its_own_band(tmp_path):
+    """A regressed round that also reports a wide spread must not mask
+    itself: the noise band comes from the PRIOR rounds only."""
+    paths = _history(tmp_path, [1.67, 1.67, 1.67])
+    paths.append(_write_round(tmp_path, 4, 1.34, spread=0.30))
+    report = perf_gate.check_files(paths)
+    assert any(f["key"] == "value" and f["latest_round"] == 4
+               for f in report["findings"]), "self-masked regression"
+
+
+def test_passes_within_noise_band(tmp_path):
+    paths = _history(tmp_path, [1.67, 1.672, 1.669, 1.671, 1.665])
+    assert perf_gate.check_files(paths)["findings"] == []
+    assert perf_gate.main(["--check", str(tmp_path / "BENCH_r*.json")]) == 0
+
+
+def test_flags_attained_fraction_regression(tmp_path):
+    """A throughput-neutral roofline fraction drop (slower kernel hidden
+    behind a faster host) is still flagged."""
+    def roof(frac):
+        return {"roofline": {"phases": {"train_chunk": {
+            "frac_of_peak_flops": frac}}}}
+
+    paths = [_write_round(tmp_path, i + 1, 1.67, extra=roof(f))
+             for i, f in enumerate([0.93, 0.931, 0.929, 0.93, 0.70])]
+    report = perf_gate.check_files(paths)
+    keys = [f["key"] for f in report["findings"]]
+    assert "roofline/train_chunk/frac_of_peak_flops" in keys
+
+
+def test_satellite_keys_checked(tmp_path):
+    paths = _history(
+        tmp_path, [1.67, 1.67, 1.67],
+        extra={"parity_leafwise_f32_iters_per_sec": 0.39,
+               "parity_spread": 0.03})
+    # regress only the parity satellite in a 4th round
+    paths.append(_write_round(
+        tmp_path, 4, 1.67,
+        extra={"parity_leafwise_f32_iters_per_sec": 0.30,
+               "parity_spread": 0.03}))
+    report = perf_gate.check_files(paths)
+    keys = [f["key"] for f in report["findings"]]
+    assert keys == ["parity_leafwise_f32_iters_per_sec"]
+
+
+def test_metric_groups_are_not_cross_compared(tmp_path):
+    """A 1M round followed by 11M rounds (the real r01->r02 shape): the
+    scale change must not read as an 80% regression."""
+    paths = [_write_round(tmp_path, 1, 7.99, metric="iters_1m")]
+    paths += [_write_round(tmp_path, n, v, metric="iters_11m")
+              for n, v in ((2, 1.674), (3, 1.672))]
+    assert perf_gate.check_files(paths)["findings"] == []
+
+
+def test_refuses_cross_hardware_comparison(tmp_path):
+    paths = [
+        _write_round(tmp_path, 1, 1.67, host={"device_kind": "TPU v5 lite"}),
+        _write_round(tmp_path, 2, 0.9, host={"device_kind": "TPU v4"}),
+    ]
+    with pytest.raises(perf_gate.GateError, match="device kinds"):
+        perf_gate.check_files(paths)
+    assert perf_gate.main(["--check", str(tmp_path / "BENCH_r*.json")]) == 2
+    # explicit override compares anyway (and then flags the drop)
+    report = perf_gate.check_files(paths, allow_cross_hardware=True)
+    assert report["findings"]
+
+
+def test_multichip_ok_to_notok_flagged(tmp_path):
+    ok = tmp_path / "MULTICHIP_r01.json"
+    ok.write_text(json.dumps({"n_devices": 8, "rc": 0, "ok": True}))
+    bad = tmp_path / "MULTICHIP_r02.json"
+    bad.write_text(json.dumps({"n_devices": 8, "rc": 1, "ok": False}))
+    report = perf_gate.check_files([str(ok), str(bad)])
+    assert any(f["metric"] == "multichip" for f in report["findings"])
+
+
+def test_malformed_file_is_a_one_line_error(tmp_path):
+    p = tmp_path / "BENCH_r01.json"
+    p.write_text("{not json")
+    with pytest.raises(perf_gate.GateError):
+        perf_gate.check_files([str(p)])
+    assert perf_gate.main(["--check", str(p)]) == 2
+    with pytest.raises(perf_gate.GateError, match="no bench history"):
+        perf_gate.check_files([])
+
+
+# ------------------------------------------------------------ real trajectory
+
+def test_real_bench_trajectory_passes():
+    """The repo's committed BENCH_r*/MULTICHIP_r* history is the no-false-
+    positive gate: the documented pre-merge check
+    (``python scripts/perf_gate.py --check 'BENCH_r*.json'``) must pass."""
+    import glob
+    paths = sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json"))
+                   + glob.glob(os.path.join(REPO, "MULTICHIP_r*.json")))
+    if not paths:
+        pytest.skip("no committed bench history")
+    report = perf_gate.check_files(paths)
+    assert report["findings"] == [], report["findings"]
+    assert len(report["groups"]) >= 1
