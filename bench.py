@@ -329,6 +329,11 @@ def main() -> int:
         out["roofline"] = snap["roofline"]
     if "compile" in snap:
         out["compile"] = snap["compile"]
+    # interconnect block (ISSUE 5): per-collective-site logical bytes and
+    # attained GB/s — present when a parallel learner's collective seams
+    # were traced (multi-device runs); absent on serial runs
+    if "interconnect" in snap:
+        out["interconnect"] = snap["interconnect"]
 
     # memory trajectory (ISSUE 2): peak HBM watermark + dataset residency,
     # so BENCH_*.json rounds stop hand-measuring footprints (PROFILE.md)

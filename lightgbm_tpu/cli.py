@@ -37,10 +37,20 @@ class Application:
         mem_on = io.memory_stats_enabled()
         if io.metrics_out or mem_on:
             telemetry.enable(io.metrics_out or None,
-                             fence=io.metrics_fence, memory=mem_on)
+                             fence=io.metrics_fence, memory=mem_on,
+                             # timeline="auto" resolves again after
+                             # distributed init (init_train); a forced
+                             # "true" arms shard mode immediately
+                             timeline=(io.timeline == "true"))
             telemetry.reset()
-            log.debug("telemetry armed: metrics_out=%s fence=%s memory=%s"
-                      % (io.metrics_out, io.metrics_fence, mem_on))
+            log.debug("telemetry armed: metrics_out=%s fence=%s memory=%s "
+                      "timeline=%s"
+                      % (io.metrics_out, io.metrics_fence, mem_on,
+                         io.timeline))
+        if io.stall_timeout > 0:
+            # hung-collective flight recorder (ISSUE 5): gbdt.run_training
+            # arms the watchdog thread around the training loop
+            telemetry.configure_watchdog(io.stall_timeout)
         self.boosting: GBDT = None
         self.objective = None
         self.train_data = None
@@ -70,6 +80,11 @@ class Application:
             tree.feature_fraction_seed = sync_up_by_min(tree.feature_fraction_seed)
             tree.feature_fraction = sync_up_by_min(tree.feature_fraction)
             learner = create_parallel_learner(self.config)
+            # timeline="auto" resolves HERE, after distributed init, when
+            # process_count is final: multi-process runs get per-process
+            # shards (the clock handshake ran inside init_distributed)
+            if self.config.io_config.timeline_enabled():
+                telemetry.set_timeline(True)
 
         self.boosting = GBDT()
         predict_fun = None

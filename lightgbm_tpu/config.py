@@ -152,6 +152,18 @@ class IOConfig:
     # one-shot dataset-residency report at train start.  "auto" (default)
     # = on whenever metrics_out is set; "true"/"false" force it.
     memory_stats: str = "auto"
+    # Distributed observability (ISSUE 5): timeline mode writes one JSONL
+    # shard PER PROCESS (``<metrics_out>.shard-<i>of<n>.jsonl``, headed
+    # by a host/clock record) instead of a leader-only file — merge with
+    # scripts/timeline_report.py.  "auto" = on for multi-process runs
+    # whenever metrics_out is set; "true"/"false" force it.
+    timeline: str = "auto"
+    # Hung-collective flight recorder: with stall_timeout > 0 (seconds)
+    # a watchdog thread dumps the recent span/collective event ring, the
+    # in-flight phase/iteration and all thread stacks to the sink when
+    # training makes no progress for that long — before the runtime's
+    # own opaque dispatch watchdog kills the job.  0 disables.
+    stall_timeout: float = 0.0
     output_result: str = "LightGBM_predict_result.txt"
     input_model: str = ""
     input_init_score: str = ""
@@ -179,6 +191,23 @@ class IOConfig:
         return (self.memory_stats == "true"
                 or (self.memory_stats == "auto" and bool(self.metrics_out)))
 
+    def timeline_enabled(self) -> bool:
+        """The ``timeline=`` resolution rule, single-homed: "auto" = per-
+        process shards on for TRUE multi-process runs with a sink (the
+        exact case where a leader-only file hides every other host);
+        "true" forces shard mode even single-process, "false" keeps the
+        leader-only sink.  Consulted AFTER distributed init (cli.py), so
+        process_count is final."""
+        if self.timeline == "true":
+            return True
+        if self.timeline != "auto" or not self.metrics_out:
+            return False
+        try:
+            import jax
+            return jax.process_count() > 1
+        except Exception:
+            return False
+
     def set(self, params: Dict[str, str], require_data: bool = True) -> None:
         self.max_bin = _get_int(params, "max_bin", self.max_bin)
         log.check(self.max_bin > 0, "max_bin should be > 0")
@@ -197,6 +226,15 @@ class IOConfig:
             log.check(value in ("auto", "true", "false"),
                       "memory_stats must be auto, true or false")
             self.memory_stats = value
+        if "timeline" in params:
+            value = params["timeline"].lower()
+            log.check(value in ("auto", "true", "false"),
+                      "timeline must be auto, true or false")
+            self.timeline = value
+        self.stall_timeout = _get_float(params, "stall_timeout",
+                                        self.stall_timeout)
+        log.check(self.stall_timeout >= 0.0,
+                  "stall_timeout should be >= 0")
         self.num_model_predict = _get_int(params, "num_model_predict", self.num_model_predict)
         self.is_pre_partition = _get_bool(params, "is_pre_partition", self.is_pre_partition)
         self.is_enable_sparse = _get_bool(params, "is_enable_sparse", self.is_enable_sparse)

@@ -585,6 +585,17 @@ class GBDT:
             log.fatal("multi-process feature-parallel training requires "
                       "the fused chunk path: grow_policy=depthwise and a "
                       "device formulation for every configured metric")
+        # hung-collective flight recorder (ISSUE 5): with stall_timeout=
+        # configured, a watchdog thread records span/collective events in
+        # a ring buffer and — if no event lands for the timeout — dumps
+        # the ring + in-flight phase/iteration/collective + thread stacks
+        # to the sink BEFORE the environment's opaque ~60 s dispatch
+        # watchdog kills the job.  Armed here, next to the crash-flush,
+        # so both abnormal-end paths leave a record.
+        wd_armed = telemetry.arm_watchdog()
+        if wd_armed:
+            telemetry.watchdog_checkin(phase="run_training",
+                                       iteration=self.iter)
         try:
             if not self.chunkable_for(is_eval) or (num_iterations < chunk_size
                                                    and not self._mp_fp):
@@ -594,6 +605,8 @@ class GBDT:
                 # it computes
                 for _ in range(num_iterations):
                     finished = self.train_one_iter(is_eval=is_eval)
+                    if wd_armed:
+                        telemetry.watchdog_checkin(iteration=self.iter)
                     if save_fn is not None:
                         save_fn()
                     if progress_fn is not None:
@@ -609,6 +622,8 @@ class GBDT:
                     stop = self.train_chunk(chunk_size,
                                             limit=num_iterations - done,
                                             is_eval=is_eval)
+                    if wd_armed:
+                        telemetry.watchdog_checkin(iteration=self.iter)
                     if save_fn is not None:
                         save_fn()
                     if progress_fn is not None:
@@ -635,6 +650,9 @@ class GBDT:
                 except Exception:
                     pass
             raise
+        finally:
+            if wd_armed:
+                telemetry.disarm_watchdog()
         if self._host_inputs:
             # fold every host's route counters into the leader before the
             # summary.  COLLECTIVE, hence outside any telemetry.enabled()

@@ -159,6 +159,24 @@ def grow_tree_impl(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     finder = split_finder or find_best_split
     if partition_bins is None:
         partition_bins = bins
+    # wire-metrics hook point (ISSUE 5): any seam not already labeled by
+    # the learner that built it (telemetry.collective_span passes wrapped
+    # fns through) gets a grower-generic site here, so custom learners'
+    # collectives still show up in the interconnect block.  The wrappers
+    # call the seam unchanged — traced programs are bit-identical.
+    from .. import telemetry as _tl
+    hist_reduce = _tl.collective_span(
+        "leafwise/hist_reduce", hist_reduce, kind="reduce", axis=hist_axis,
+        loop=L - 1, phase="grow")
+    int_hist_reduce = _tl.collective_span(
+        "leafwise/int_hist_reduce", int_hist_reduce, kind="reduce",
+        axis=hist_axis, loop=L - 1, phase="grow")
+    stat_reduce = _tl.collective_span(
+        "leafwise/root_stats", stat_reduce, kind="reduce", axis=hist_axis,
+        phase="grow")
+    root_hist_reduce = _tl.collective_span(
+        "leafwise/root_hist", root_hist_reduce, kind="reduce",
+        axis=hist_axis, phase="grow")
 
     def hist_of(mask, salt=0):
         hist = build_histogram(bins, grad, hess, mask, B,

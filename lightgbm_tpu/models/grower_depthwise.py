@@ -102,6 +102,23 @@ def grow_tree_depthwise(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     f32 = jnp.float32
     i32 = jnp.int32
 
+    # wire-metrics hook point (ISSUE 5): label any seam the learner did
+    # not already wrap (collective_span passes wrapped fns through); the
+    # level reducers trace once per level, so loop stays 1 per trace
+    from .. import telemetry as _tl
+    hist_reduce = _tl.collective_span(
+        "depthwise/hist_reduce", hist_reduce, kind="reduce",
+        axis=hist_axis, phase="grow")
+    hist_reduce_level = _tl.collective_span(
+        "depthwise/level_hist_reduce", hist_reduce_level, kind="reduce",
+        axis=hist_axis, phase="grow")
+    int_reduce_level = _tl.collective_span(
+        "depthwise/level_int_reduce", int_reduce_level, kind="reduce",
+        axis=hist_axis, phase="grow")
+    stat_reduce = _tl.collective_span(
+        "depthwise/root_stats", stat_reduce, kind="reduce", axis=hist_axis,
+        phase="grow")
+
     maskf = row_mask.astype(f32)
     mind = float(min_data_in_leaf)
     minh = float(min_sum_hessian_in_leaf)

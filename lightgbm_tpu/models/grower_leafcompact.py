@@ -183,6 +183,21 @@ def grow_tree_leafcompact_impl(bins, grad, hess, row_mask, feature_mask,
     L = num_leaves
     B = num_bins_max
     f32 = jnp.float32
+    # wire-metrics hook point (ISSUE 5): label any seam the learner did
+    # not already wrap (collective_span passes wrapped fns through)
+    from .. import telemetry as _tl
+    hist_reduce = _tl.collective_span(
+        "leafcompact/hist_reduce", hist_reduce, kind="reduce",
+        axis=hist_axis, loop=L - 1, phase="grow")
+    int_hist_reduce = _tl.collective_span(
+        "leafcompact/int_hist_reduce", int_hist_reduce, kind="reduce",
+        axis=hist_axis, loop=L - 1, phase="grow")
+    stat_reduce = _tl.collective_span(
+        "leafcompact/root_stats", stat_reduce, kind="reduce",
+        axis=hist_axis, phase="grow")
+    root_hist_reduce = _tl.collective_span(
+        "leafcompact/root_hist", root_hist_reduce, kind="reduce",
+        axis=hist_axis, phase="grow")
     table = bucket_table(N, min_width=max(BLOCK, (-(-N // BLOCK) * BLOCK)
                                           >> 9))
     P = table[0]
@@ -416,6 +431,12 @@ def grow_tree_leafcompact_impl(bins, grad, hess, row_mask, feature_mask,
             sstart = jnp.where(left_small, start, start + plcnt)
             hk_span = scnt
             if hist_axis is not None:
+                # tier-selector sync: a scalar pmax per split — tiny on
+                # the wire but a full collective latency, so it belongs
+                # in the interconnect inventory
+                _tl.record_collective(
+                    "leafcompact/tier_pmax", "pmax", hist_axis,
+                    _tl._tree_nbytes(hk_span), loop=L - 1, phase="grow")
                 hk_span = jax.lax.pmax(hk_span, hist_axis)
             small_hist = jax.lax.switch(
                 bucket_of(hk_span), hist_branches,

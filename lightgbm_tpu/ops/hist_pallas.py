@@ -239,6 +239,10 @@ def quantize_values(grad, hess, col_ok, rng_bits=None, axis_name=None,
     ag = jnp.max(jnp.abs(grad) * okf)
     ah = jnp.max(jnp.abs(hess) * okf)
     if axis_name is not None:
+        from .. import telemetry
+        telemetry.record_collective("hist/quant_scale_pmax", "pmax",
+                                    axis_name,
+                                    telemetry._tree_nbytes((ag, ah)))
         ag = jax.lax.pmax(ag, axis_name)
         ah = jax.lax.pmax(ah, axis_name)
     gs = jnp.maximum(ag, 1e-30) / 127.0
@@ -285,6 +289,10 @@ def quant_saturation_count(grad, hess, axis_name=None):
     count — every shard reports the identical global gauge."""
     f32 = jnp.float32
     total = jnp.zeros((), f32)
+    if axis_name is not None:
+        from .. import telemetry
+        telemetry.record_collective("health/quant_sat_reduce", "psum",
+                                    axis_name, 2 * 4)
     for x in (grad, hess):
         ax = jnp.where(jnp.isfinite(x), jnp.abs(x), 0.0)
         m = jnp.max(ax)
@@ -365,6 +373,9 @@ def _hist_pallas_one(bins, grad, hess, col_id, col_ok, num_cols, B, *,
         # reduce the INT accumulators across shards: dequantize-then-psum
         # would round (sum of 8 f32 products != int-sum x scale) and break
         # the bit-identical serial == data-parallel invariant
+        from .. import telemetry
+        telemetry.record_collective("hist/int8_pallas_psum", "psum",
+                                    axis_name, telemetry._tree_nbytes(acc))
         acc = jax.lax.psum(acc, axis_name)
     hist = acc[:, :, :num_cols * 3].astype(jnp.float32)
     hist = hist.reshape(F, B, num_cols, 3).transpose(2, 0, 1, 3)
@@ -514,6 +525,9 @@ def _hist_quant_xla_one(bins, grad, hess, col_id, col_ok, num_cols, B, *,
         hist = int_reduce(hist)                # int-domain feature scatter
         F = hist.shape[0]
     elif axis_name is not None:
+        from .. import telemetry
+        telemetry.record_collective("hist/int8_xla_psum", "psum",
+                                    axis_name, telemetry._tree_nbytes(hist))
         hist = jax.lax.psum(hist, axis_name)   # int-domain cross-shard sum
     hist = hist.reshape(F, B, C, 3).transpose(2, 0, 1, 3).astype(jnp.float32)
     return hist * scale

@@ -379,6 +379,9 @@ def hist_quant_segsum(bins, grad, hess, col_id, col_ok, num_cols: int,
     hist = jax.ops.segment_sum(v, ids.reshape(-1),
                                num_segments=(C + 1) * F * B)
     if axis_name is not None:
+        from .. import telemetry
+        telemetry.record_collective("hist/int8_segsum_psum", "psum",
+                                    axis_name, telemetry._tree_nbytes(hist))
         hist = jax.lax.psum(hist, axis_name)   # int-domain cross-shard sum
     hist = hist.reshape(C + 1, F, B, 3)[:C].astype(jnp.float32)
     return hist * scale

@@ -107,9 +107,17 @@ except ImportError:  # pragma: no cover - older JAX
                               out_specs=out_specs, check_rep=check_rep)
 
 
-def allreduce_best_split(res: SplitResult, axis_name: str) -> SplitResult:
+def allreduce_best_split(res: SplitResult, axis_name: str,
+                         site: str = None, loop: int = 1,
+                         phase: str = None) -> SplitResult:
     """SplitInfo::MaxReducer as an argmax allreduce (split_info.hpp:56-104):
-    max gain wins; ties broken by the smaller (global) feature index."""
+    max gain wins; ties broken by the smaller (global) feature index.
+    ``site`` files the traced collective in the telemetry wire-metrics
+    registry (ISSUE 5) — payload is the packed SplitInfo struct."""
+    if site is not None:
+        telemetry.record_collective(site, "all_gather", axis_name,
+                                    telemetry._tree_nbytes(res),
+                                    loop=loop, phase=phase)
     stacked = jax.tree.map(lambda x: jax.lax.all_gather(x, axis_name), res)
     gain = stacked.gain
     max_gain = jnp.max(gain)
@@ -119,7 +127,8 @@ def allreduce_best_split(res: SplitResult, axis_name: str) -> SplitResult:
     return jax.tree.map(lambda x: x[pick], stacked)
 
 
-def ownership_finder(own_s, axis_name):
+def ownership_finder(own_s, axis_name, site: str = None, loop: int = 1,
+                     phase: str = None):
     """Owned-block split finder shared by the feature-parallel learner and
     the data-parallel reduce_scatter schedule: local FindBestThreshold over
     the owned feature block, block-local -> global feature remap, then the
@@ -128,20 +137,31 @@ def ownership_finder(own_s, axis_name):
         local = find_best_split(hist, sg, sh, cnt, nb, fm, mind, minh)
         local = local._replace(
             feature=own_s[local.feature].astype(jnp.int32))
-        return allreduce_best_split(local, axis_name)
+        return allreduce_best_split(local, axis_name, site=site,
+                                    loop=loop, phase=phase)
     return finder
 
 
-def dp_ownership_seams(F: int, num_shards: int):
+def dp_ownership_seams(F: int, num_shards: int, site_prefix: str = "dp_rs",
+                       loop: int = 1, phase: str = "grow",
+                       root_loop: int = 1):
     """Contiguous-feature-block ownership seams for the data-parallel
     reduce_scatter schedule (data_parallel_tree_learner.cpp:135-235),
     shared by the masked and COMPACTED leaf-wise shard closures: returns
     a traced-context function (fmask, nbins) -> kwargs for the grower's
     ownership seam set.  ``fmask_own``/``nbins_own`` are the owned
     slices to pass positionally; the rest map 1:1 onto
-    grow_tree_impl/grow_tree_leafcompact_impl's keyword seams."""
+    grow_tree_impl/grow_tree_leafcompact_impl's keyword seams.
+
+    ``site_prefix``/``loop``/``phase`` label the wire-metrics sites
+    (telemetry.collective_span, ISSUE 5): per-split seams run inside the
+    grower's split loop, so the caller passes its executed-calls-per-
+    trace estimate as ``loop`` (e.g. num_leaves-1 for the leaf-wise
+    fori_loop, x chunk length on the fused path)."""
     Fb = -(-F // num_shards)
     Fpad = Fb * num_shards
+    _c = functools.partial(telemetry.collective_span, axis=DATA_AXIS,
+                           phase=phase)
 
     def seams(fmask, nbins):
         rank = jax.lax.axis_index(DATA_AXIS)
@@ -167,15 +187,23 @@ def dp_ownership_seams(F: int, num_shards: int):
             return jax.lax.dynamic_slice_in_dim(
                 pad_f(h), rank * Fb, Fb, axis=0)
 
+        scat = _c(site_prefix + "/hist_scatter", scatter0,
+                  kind="psum_scatter", loop=loop)
         return dict(
             fmask_own=fmask[own_s] & ownok,
             nbins_own=jnp.take(nbins, own_s),
-            hist_reduce=scatter0, int_hist_reduce=scatter0,
+            hist_reduce=scat, int_hist_reduce=scat,
             hist_axis=DATA_AXIS,
-            stat_reduce=lambda s: jax.lax.psum(s, DATA_AXIS),
-            root_hist_reduce=lambda h: jax.lax.psum(h, DATA_AXIS),
+            stat_reduce=_c(site_prefix + "/root_stats",
+                           lambda s: jax.lax.psum(s, DATA_AXIS),
+                           kind="psum", loop=root_loop),
+            root_hist_reduce=_c(site_prefix + "/root_hist",
+                                lambda h: jax.lax.psum(h, DATA_AXIS),
+                                kind="psum", loop=root_loop),
             own_slice=own_slice,
-            split_finder=ownership_finder(own_s, DATA_AXIS))
+            split_finder=ownership_finder(
+                own_s, DATA_AXIS, site=site_prefix + "/splitinfo_allreduce",
+                loop=loop, phase=phase))
     return seams
 
 
@@ -265,7 +293,11 @@ class DataParallelLearner(_ParallelLearnerBase):
         N-machine mode in its native growth order
         (data_parallel_tree_learner.cpp:135-235 driving
         serial_tree_learner.cpp:119-153)."""
-        seams = dp_ownership_seams(F, num_shards)
+        # per-split seams run in the grower's fori_loop: traced once,
+        # executed once per split (wire-metrics loop estimate)
+        seams = dp_ownership_seams(F, num_shards,
+                                   site_prefix="dp_rs/leafwise",
+                                   loop=kwargs["num_leaves"] - 1)
 
         def shard_grow(bins_s, grad_s, hess_s, mask_s, fmask, nbins,
                        **extra):
@@ -277,10 +309,15 @@ class DataParallelLearner(_ParallelLearnerBase):
                 **s, **kwargs, **extra)
         return shard_grow
 
-    def _scatter_grow_fn(self, grow, kwargs, F: int, num_shards: int):
-        """Per-shard grow closure for the reduce_scatter schedule."""
+    def _scatter_grow_fn(self, grow, kwargs, F: int, num_shards: int,
+                         phase: str = "train_chunk", loop_scale: int = 1):
+        """Per-shard grow closure for the reduce_scatter schedule.
+        ``loop_scale`` multiplies the wire-metrics executed-calls
+        estimate (the fused chunk traces once, executes k times)."""
         Fb = -(-F // num_shards)
         Fpad = Fb * num_shards
+        _c = functools.partial(telemetry.collective_span, axis=DATA_AXIS,
+                               phase=phase, loop=loop_scale)
 
         def shard_grow(bins_s, grad_s, hess_s, mask_s, fmask, nbins):
             rank = jax.lax.axis_index(DATA_AXIS)
@@ -316,12 +353,21 @@ class DataParallelLearner(_ParallelLearnerBase):
 
             return grow(
                 bins_s, grad_s, hess_s, mask_s, fmask_own, nbins_own,
-                hist_reduce=lambda h: jax.lax.psum(h, DATA_AXIS),
-                stat_reduce=lambda s: jax.lax.psum(s, DATA_AXIS),
+                hist_reduce=_c("dp_rs/depthwise/root_hist",
+                               lambda h: jax.lax.psum(h, DATA_AXIS),
+                               kind="psum"),
+                stat_reduce=_c("dp_rs/depthwise/root_stats",
+                               lambda s: jax.lax.psum(s, DATA_AXIS),
+                               kind="psum"),
                 hist_axis=DATA_AXIS,
-                split_finder=ownership_finder(own_s, DATA_AXIS),
-                hist_reduce_level=hist_scatter,
-                int_reduce_level=int_reduce,
+                split_finder=ownership_finder(
+                    own_s, DATA_AXIS,
+                    site="dp_rs/depthwise/splitinfo_allreduce",
+                    loop=loop_scale, phase=phase),
+                hist_reduce_level=_c("dp_rs/depthwise/level_hist_scatter",
+                                     hist_scatter, kind="psum_scatter"),
+                int_reduce_level=_c("dp_rs/depthwise/level_int_scatter",
+                                    int_reduce, kind="psum_scatter"),
                 own_slice=own_slice,
                 **kwargs)
         return shard_grow
@@ -398,6 +444,10 @@ class DataParallelLearner(_ParallelLearnerBase):
 
         grow = grow_tree_depthwise if depthwise else grow_tree_impl
         lrf = jnp.float32(lr)
+        # wire-metrics loop estimate: the scan body traces ONCE but runs k
+        # times per chunk; shard_chunk fills in k (row_masks.shape[0])
+        # before anything inside the body is traced
+        chunk_k = [1]
 
         def gathered(f):
             # train metrics need the GLOBAL score: gather the row shards
@@ -408,6 +458,10 @@ class DataParallelLearner(_ParallelLearnerBase):
             # true row ranges in process order — matching the order the
             # global metric metadata was gathered in (gbdt.init)
             def g(p, s):
+                telemetry.record_collective(
+                    "dp/metric_score_allgather", "all_gather", DATA_AXIS,
+                    telemetry._tree_nbytes(s), loop=chunk_k[0],
+                    phase="train_chunk")
                 full = jax.lax.all_gather(s, DATA_AXIS, axis=-1, tiled=True)
                 if shard_layout is None:
                     comp = full[..., :n_true]
@@ -433,6 +487,10 @@ class DataParallelLearner(_ParallelLearnerBase):
             base_grad_fn = grad_fn
 
             def grad_fn(params, score):
+                telemetry.record_collective(
+                    "dp/grad_score_allgather", "all_gather", DATA_AXIS,
+                    telemetry._tree_nbytes(score), loop=chunk_k[0],
+                    phase="train_chunk")
                 full = jax.lax.all_gather(score, DATA_AXIS, axis=-1,
                                           tiled=True)
                 g, h = base_grad_fn(params, full)
@@ -447,19 +505,36 @@ class DataParallelLearner(_ParallelLearnerBase):
                         feat_masks, obj_params, train_mparams, valid_bins,
                         valid_scores, valid_mparams):
             from ..models.gbdt import make_chunk_body
+            chunk_k[0] = int(row_masks.shape[0])
             if use_compact:
                 # same grower (and the same schedule dispatch) on the
                 # chunk path as on __call__'s per-iteration path
                 grow_fn = self._compact_grow_fn(kwargs, num_features,
-                                                num_shards)
+                                                num_shards,
+                                                phase="train_chunk",
+                                                loop_scale=chunk_k[0])
             elif use_scatter:
                 grow_fn = self._scatter_grow_fn(grow, kwargs, num_features,
-                                                num_shards)
+                                                num_shards,
+                                                phase="train_chunk",
+                                                loop_scale=chunk_k[0])
             else:
+                _c = functools.partial(
+                    telemetry.collective_span, axis=DATA_AXIS,
+                    phase="train_chunk")
+                # depthwise traces the level reduce per (unrolled) level;
+                # the leaf-wise fori_loop traces its hist_reduce ONCE but
+                # runs it once per split — same convention as _grow_fn
+                hist_loop = chunk_k[0] * (1 if depthwise
+                                          else kwargs["num_leaves"] - 1)
                 grow_fn = lambda *a: grow(
                     *a,
-                    hist_reduce=lambda h: jax.lax.psum(h, DATA_AXIS),
-                    stat_reduce=lambda s: jax.lax.psum(s, DATA_AXIS),
+                    hist_reduce=_c("dp_psum/chunk/hist_allreduce",
+                                   lambda h: jax.lax.psum(h, DATA_AXIS),
+                                   kind="psum", loop=hist_loop),
+                    stat_reduce=_c("dp_psum/chunk/root_stats",
+                                   lambda s: jax.lax.psum(s, DATA_AXIS),
+                                   kind="psum", loop=chunk_k[0]),
                     hist_axis=DATA_AXIS,
                     **kwargs)
             body = make_chunk_body(
@@ -511,7 +586,8 @@ class DataParallelLearner(_ParallelLearnerBase):
         from ..models.gbdt import leafwise_compact_on
         return leafwise_compact_on(self.tree_config)
 
-    def _compact_grow_fn(self, kwargs, F: int, num_shards: int):
+    def _compact_grow_fn(self, kwargs, F: int, num_shards: int,
+                         phase: str = "grow", loop_scale: int = 1):
         """Per-shard COMPACTED leaf-wise closure for the ACTIVE schedule:
         each shard keeps its local rows physically partitioned
         (grower_leafcompact.py) and the per-split smaller-child
@@ -531,9 +607,15 @@ class DataParallelLearner(_ParallelLearnerBase):
         from ..ops.compact import pallas_partition_ok, partition_overlap_on
         use_pallas = pallas_partition_ok(F)
         overlap = partition_overlap_on()
+        # per-split seams run once per split; x the fused-chunk length on
+        # the chunk path (wire-metrics executed-calls estimate)
+        split_loop = (kwargs["num_leaves"] - 1) * loop_scale
 
         if self._schedule() == "reduce_scatter":
-            seams = dp_ownership_seams(F, num_shards)
+            seams = dp_ownership_seams(F, num_shards,
+                                       site_prefix="dp_rs/leafcompact",
+                                       loop=split_loop, phase=phase,
+                                       root_loop=loop_scale)
 
             def shard_grow(bins_s, grad_s, hess_s, mask_s, fmask, nbins):
                 s = seams(fmask, nbins)
@@ -545,11 +627,18 @@ class DataParallelLearner(_ParallelLearnerBase):
                     **s, **kwargs)
             return shard_grow
 
+        _c = functools.partial(telemetry.collective_span, axis=DATA_AXIS,
+                               phase=phase)
+
         def shard_grow(bins_s, grad_s, hess_s, mask_s, fmask, nbins):
             return grow_tree_leafcompact_impl(
                 bins_s, grad_s, hess_s, mask_s, fmask, nbins,
-                hist_reduce=lambda h: jax.lax.psum(h, DATA_AXIS),
-                stat_reduce=lambda s: jax.lax.psum(s, DATA_AXIS),
+                hist_reduce=_c("dp_psum/leafcompact/hist_allreduce",
+                               lambda h: jax.lax.psum(h, DATA_AXIS),
+                               kind="psum", loop=split_loop),
+                stat_reduce=_c("dp_psum/leafcompact/root_stats",
+                               lambda s: jax.lax.psum(s, DATA_AXIS),
+                               kind="psum", loop=loop_scale),
                 hist_axis=DATA_AXIS,
                 use_pallas_partition=use_pallas,
                 partition_overlap=overlap,
@@ -560,13 +649,19 @@ class DataParallelLearner(_ParallelLearnerBase):
         """Per-shard leaf-wise grow closure for the active schedule."""
         if self._schedule() == "reduce_scatter":
             return self._scatter_grow_fn_leafwise(kwargs, F, num_shards)
+        _c = functools.partial(telemetry.collective_span, axis=DATA_AXIS,
+                               phase="grow")
 
         def shard_grow(bins_s, grad_s, hess_s, mask_s, fmask, nbins,
                        **extra):
             return grow_tree_impl(
                 bins_s, grad_s, hess_s, mask_s, fmask, nbins,
-                hist_reduce=lambda h: jax.lax.psum(h, DATA_AXIS),
-                stat_reduce=lambda s: jax.lax.psum(s, DATA_AXIS),
+                hist_reduce=_c("dp_psum/leafwise/hist_allreduce",
+                               lambda h: jax.lax.psum(h, DATA_AXIS),
+                               kind="psum", loop=kwargs["num_leaves"] - 1),
+                stat_reduce=_c("dp_psum/leafwise/root_stats",
+                               lambda s: jax.lax.psum(s, DATA_AXIS),
+                               kind="psum"),
                 hist_axis=DATA_AXIS,
                 **kwargs, **extra)
         return shard_grow
@@ -687,11 +782,18 @@ class DataParallelLearner(_ParallelLearnerBase):
             self._jit_key = jit_key
             kwargs = self._grow_kwargs(gbdt)
             if self._depthwise:
+                _c = functools.partial(telemetry.collective_span,
+                                       axis=DATA_AXIS, phase="grow")
+
                 def shard_fn(bins_s, grad_s, hess_s, mask_s, fmask, nbins):
                     return grow_tree_depthwise(
                         bins_s, grad_s, hess_s, mask_s, fmask, nbins,
-                        hist_reduce=lambda h: jax.lax.psum(h, DATA_AXIS),
-                        stat_reduce=lambda s: jax.lax.psum(s, DATA_AXIS),
+                        hist_reduce=_c("dp_psum/depthwise/hist_allreduce",
+                                       lambda h: jax.lax.psum(h, DATA_AXIS),
+                                       kind="psum"),
+                        stat_reduce=_c("dp_psum/depthwise/root_stats",
+                                       lambda s: jax.lax.psum(s, DATA_AXIS),
+                                       kind="psum"),
                         hist_axis=DATA_AXIS,
                         **kwargs)
             elif use_compact:
@@ -780,9 +882,16 @@ class FeatureParallelLearner(_ParallelLearnerBase):
         self._own_cache = (num_shards, own, ownmask)
         return own, ownmask
 
-    def _shard_grow_fn(self, grow, kwargs, own, ownmask):
+    def _shard_grow_fn(self, grow, kwargs, own, ownmask,
+                       phase: str = "grow", loop_scale: int = 1):
         """Per-shard grow closure: slice owned features, allreduce the
-        packed SplitInfo, apply splits on the replicated full matrix."""
+        packed SplitInfo, apply splits on the replicated full matrix.
+        ``phase``/``loop_scale`` label the SplitInfo-allreduce wire-
+        metrics site (per split on the leaf-wise fori_loop, per traced
+        level depth-wise; x chunk length on the fused path)."""
+        loop = loop_scale * (1 if self._depthwise
+                             else kwargs["num_leaves"] - 1)
+
         def shard_grow(bins_full, grad_s, hess_s, mask_s, fmask, nbins):
             rank = jax.lax.axis_index(FEATURE_AXIS)
             own_s = own[rank]
@@ -793,7 +902,9 @@ class FeatureParallelLearner(_ParallelLearnerBase):
 
             return grow(
                 bins_own, grad_s, hess_s, mask_s, fmask_own, nbins_own,
-                split_finder=ownership_finder(own_s, FEATURE_AXIS),
+                split_finder=ownership_finder(
+                    own_s, FEATURE_AXIS,
+                    site="fp/splitinfo_allreduce", loop=loop, phase=phase),
                 partition_bins=bins_full, **kwargs)
         return shard_grow
 
@@ -837,7 +948,9 @@ class FeatureParallelLearner(_ParallelLearnerBase):
             body = make_chunk_body(
                 grad_fn=grad_fn, obj_params=obj_params, num_class=num_class,
                 lrf=lrf,
-                grow_fn=self._shard_grow_fn(grow, kwargs, own, ownmask),
+                grow_fn=self._shard_grow_fn(
+                    grow, kwargs, own, ownmask, phase="train_chunk",
+                    loop_scale=int(row_masks.shape[0])),
                 has_bag=has_bag, has_ff=has_ff, bins=bins,
                 num_bins=num_bins, max_nodes=max_nodes,
                 valid_bins=valid_bins, valid_mparams=valid_mparams,

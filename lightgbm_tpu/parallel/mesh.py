@@ -53,6 +53,42 @@ def init_distributed(config=None) -> None:
         # "already initialized"); anything else is a real bootstrap failure
         if not any(s in str(e).lower() for s in ("already", "once")):
             raise
+    clock_handshake()
+
+
+def clock_handshake() -> float:
+    """Cross-host clock-offset handshake (ISSUE 5), recorded at mesh
+    setup: every process allgathers its ``time.time()`` sample and
+    installs the leader-relative offset into telemetry, so per-process
+    JSONL shard timestamps can be merged onto ONE job clock by
+    scripts/timeline_report.py (cross-host skew attribution is
+    meaningless on uncorrected clocks).
+
+    The offset is accurate to ~one collective round-trip (the gathered
+    samples are taken within the allgather's skew window); the RTT is
+    recorded beside it as the error bar.  COLLECTIVE — every process of
+    a multi-process job reaches init_distributed, which calls it.
+    Single-process runs (and backends without multi-process collectives)
+    record offset 0.  Returns the installed offset."""
+    import time as _time
+    from .. import telemetry
+    if jax.process_count() <= 1:
+        telemetry.set_clock_offset(0.0)
+        return 0.0
+    try:
+        from jax.experimental import multihost_utils
+        t0 = _time.perf_counter()
+        gathered = np.asarray(multihost_utils.process_allgather(
+            np.asarray(_time.time(), np.float64))).reshape(-1)
+        rtt = _time.perf_counter() - t0
+        offset = float(gathered[0] - gathered[jax.process_index()])
+        telemetry.set_clock_offset(offset, rtt_s=rtt)
+        return offset
+    except Exception as e:  # pragma: no cover - backend capability gap
+        log.warning("clock handshake unavailable (%s); shard timestamps "
+                    "stay on local clocks" % e)
+        telemetry.set_clock_offset(0.0)
+        return 0.0
 
 
 def get_mesh(num_machines: Optional[int] = None,

@@ -85,12 +85,54 @@ ISSUE 4 — roofline attribution and compile observability
    is a mid-run recompile — counted (``jit/midrun_recompile``) and
    warned once, because it means a program cache key failed to capture
    something that changed.
+
+ISSUE 5 — distributed observability (per-collective wire metrics,
+cross-host span shards, hung-collective flight recorder):
+
+6. **Collective sites** (``collective_span`` / ``record_collective``):
+   the parallel learners' collective seams (psum / psum_scatter /
+   SplitInfo allgather — parallel/learners.py, and the growers' own
+   in-program collectives) are wrapped so every TRACED collective files
+   a site record: collective kind, mesh axis, logical payload bytes
+   (from the traced shapes/dtypes) and an executed-calls estimate
+   (traced occurrences x the caller-supplied loop factor — fori_loop
+   bodies trace once but execute per split).  The wrapper calls the
+   underlying collective unchanged, so the traced program — and
+   therefore scores — are bit-identical with the layer on or off.  The
+   summary/``snapshot()`` gain an ``interconnect`` block joining each
+   site's estimated bytes to its phase's measured (fenced) span time →
+   attained GB/s per collective site, beside the PR 4 HBM roofline.
+
+7. **Per-process span shards** (``timeline=`` config option): with
+   timeline mode on, EVERY process opens its own JSONL shard
+   (``<metrics_out>.shard-<i>of<n>.jsonl``; atomic deterministic
+   naming, line-buffered + per-record flush so a killed process leaves
+   at worst one truncated FINAL line) headed by a ``shard`` record
+   (host fingerprint, pid, process index, and the clock-offset
+   handshake parallel/mesh.clock_handshake records at setup).
+   Iteration/summary records gain a local wall-clock ``t``;
+   scripts/timeline_report.py merges shards into one job timeline and
+   computes per-phase cross-host skew.
+
+8. **Hung-collective flight recorder** (``stall_timeout=`` config
+   option): a ring buffer of the last N span/collective/iteration
+   events plus a host-side watchdog thread armed around training
+   (gbdt.run_training).  If no event lands for ``stall_timeout``
+   seconds the watchdog dumps the ring buffer, the in-flight
+   phase/iteration/collective and every thread's stack to the sink —
+   BEFORE the environment's opaque ~60 s dispatch watchdog kills the
+   job with no record of what was in flight.  The clock is injectable
+   (tests stall without real waits); the thread only ever reads state
+   and writes the dump, never touching device APIs.
 """
 from __future__ import annotations
 
+import collections
 import json
 import os
+import threading
 import time
+import traceback
 from typing import Dict, List, Optional
 
 # Canonical per-iteration phase keys — always present in iteration records
@@ -141,6 +183,30 @@ _compile_listener_installed = False
 _compile_base: "Optional[int]" = None
 _midrun_warned = False
 
+# ---- distributed observability state (ISSUE 5) ----
+# collective-site registry: site -> {kind, axis, bytes_per_call,
+# traced_calls, loop, phase} (record_collective)
+_collectives: Dict[str, dict] = {}
+# timeline mode: per-process JSONL shards + wall-clock "t" on records
+_timeline = False
+_shard_path_used: Optional[str] = None
+# clock-offset handshake result (parallel/mesh.clock_handshake): seconds
+# to ADD to this host's time.time() to land on the leader's clock
+_clock_offset = 0.0
+_clock_rtt: Optional[float] = None
+# flight recorder: ring buffer of recent events + stall watchdog thread
+_RING_CAP = 256
+_ring: "collections.deque" = collections.deque(maxlen=_RING_CAP)
+_ring_armed = False           # cheap hot-path gate (timeline or watchdog)
+_wd_timeout_cfg = 0.0         # configure_watchdog (config stall_timeout=)
+_wd_thread: Optional[threading.Thread] = None
+_wd_stop: Optional[threading.Event] = None
+_wd_clock = time.monotonic
+_wd_timeout = 0.0
+_wd_last = 0.0
+_wd_context: Dict[str, object] = {}
+_wd_dump: Optional[dict] = None   # last flight-recorder dump (tests)
+
 
 # --------------------------------------------------------------- life cycle
 
@@ -149,20 +215,25 @@ def enabled() -> bool:
 
 
 def enable(jsonl_path: Optional[str] = None, fence: bool = False,
-           memory: Optional[bool] = None) -> None:
+           memory: Optional[bool] = None,
+           timeline: Optional[bool] = None) -> None:
     """Arm the registry (and optionally a JSONL sink at ``jsonl_path``).
 
     Idempotent; a second call can attach a sink or toggle fence mode.  The
     sink file is opened lazily at first record — after jax.distributed
-    initialization — so only process 0 writes in multi-process runs.
-    ``memory`` arms/disarms the span-boundary memory gauges (None leaves
-    the current mode unchanged).
+    initialization — so only process 0 writes in multi-process runs,
+    UNLESS timeline mode is on, in which case every process writes its
+    own shard (``<path>.shard-<i>of<n>.jsonl``).  ``memory`` arms/disarms
+    the span-boundary memory gauges, ``timeline`` the per-process shard
+    mode (None leaves the current mode unchanged).
     """
     global _enabled, _fence, _sink_path, _sink_error, _sink_file, _memory
     _enabled = True
     _fence = bool(fence)
     if memory is not None:
         _memory = bool(memory)
+    if timeline is not None:
+        set_timeline(timeline)
     if jsonl_path:
         if _sink_file is not None and jsonl_path != _sink_path:
             # re-targeting an open sink: close the old handle or records
@@ -183,8 +254,17 @@ def enable(jsonl_path: Optional[str] = None, fence: bool = False,
 
 
 def disable() -> None:
-    """Stop recording and close the sink (pending data is flushed)."""
+    """Stop recording and close the sink (pending data is flushed).
+    Also disarms the stall watchdog and leaves timeline mode — the
+    registry returns to its process-global resting state."""
     global _enabled, _fence, _sink_file, _sink_path, _memory
+    global _timeline, _shard_path_used, _wd_timeout_cfg
+    disarm_watchdog()
+    _timeline = False
+    _shard_path_used = None
+    _wd_timeout_cfg = 0.0
+    set_shard_identity(None)
+    _update_ring_armed()
     _enabled = False
     _fence = False
     _memory = False
@@ -228,6 +308,8 @@ def reset() -> None:
     _mem_dev_peak_base = None    # re-baselined at the next sample
     _residency = None
     _allhosts_mem_peak = None
+    _collectives.clear()
+    _ring.clear()
     del _span_stack[:]
 
 
@@ -371,6 +453,382 @@ def set_residency(report: dict) -> None:
         write_record({"residency": _residency})
 
 
+# ----------------------------------------------------- collective sites
+
+def _tree_nbytes(args) -> int:
+    """Logical payload bytes of a collective's operands, from the traced
+    shapes/dtypes (tracers carry .size/.dtype like concrete arrays)."""
+    total = 0
+    try:
+        import jax
+        for leaf in jax.tree.leaves(args):
+            size = getattr(leaf, "size", None)
+            dt = getattr(leaf, "dtype", None)
+            if size is not None and dt is not None:
+                total += int(size) * int(getattr(dt, "itemsize", 4))
+    except Exception:
+        pass
+    return total
+
+
+def record_collective(site: str, kind: str, axis: Optional[str],
+                      nbytes: int, loop: int = 1,
+                      phase: Optional[str] = None) -> None:
+    """File one traced collective occurrence at ``site``.
+
+    Collectives are trace-time events like the kernel-route counters: the
+    compiled program replays the traced collective forever, so one record
+    per trace occurrence IS the inventory of what the program moves.
+    ``loop`` is the caller's executed-calls-per-trace estimate (a seam
+    invoked inside a fori_loop body traces once but runs once per split);
+    ``phase`` names the telemetry span whose measured time prices this
+    site's wire seconds in the ``interconnect`` block."""
+    if not _enabled:
+        return
+    if phase is None and _span_stack:
+        # default attribution: the OUTERMOST active span is the host-side
+        # phase the compiled program executes under ("grow"/"train_chunk")
+        # — inner spans at trace time are trace-time spans
+        phase = _span_stack[0]
+    rec = _collectives.get(site)
+    if rec is None:
+        rec = _collectives[site] = {
+            "kind": kind, "axis": axis, "bytes_per_call": int(nbytes),
+            "traced_calls": 0, "loop": max(int(loop), 1), "phase": phase}
+    rec["traced_calls"] += 1
+    # shapes can differ between traces (re-trace at a new shape): keep the
+    # largest payload as the representative per-call cost
+    rec["bytes_per_call"] = max(rec["bytes_per_call"], int(nbytes))
+    if _ring_armed:
+        _ring_event("collective", site)
+
+
+def collective_span(site: str, fn, *, kind: str, axis: Optional[str] = None,
+                    loop: int = 1, phase: Optional[str] = None):
+    """Wrap a collective seam callable so each TRACED invocation files a
+    site record (kind, mesh axis, payload bytes from the traced avals).
+
+    The wrapper calls ``fn`` unchanged — nothing is inserted into the
+    traced program, so enabling/disabling the layer perturbs neither
+    numerics nor jit caching.  ``None`` passes through (optional seams);
+    an already-wrapped fn is returned as-is (the first wrap, closest to
+    the collective, keeps the most precise kind/loop metadata)."""
+    if fn is None:
+        return None
+    if getattr(fn, "_tl_collective_site", None) is not None:
+        return fn
+
+    def wrapped(*args, **kwargs):
+        record_collective(site, kind, axis, _tree_nbytes((args, kwargs)),
+                          loop=loop, phase=phase)
+        return fn(*args, **kwargs)
+
+    wrapped._tl_collective_site = site
+    return wrapped
+
+
+def collectives() -> Dict[str, dict]:
+    return {k: dict(v) for k, v in _collectives.items()}
+
+
+def interconnect_snapshot() -> Optional[dict]:
+    """The ``interconnect`` block: per-site estimated bytes moved joined
+    to the owning phase's measured span seconds → attained GB/s per
+    collective site and per phase.  Estimates: executed calls =
+    traced_calls x loop x the phase's span count (the cached program
+    replays its collectives on every execution); byte counts are the
+    LOGICAL payload (shapes x dtypes) — on-wire bytes depend on the
+    collective algorithm (a psum moves ~2x(S-1)/S of the payload per
+    hop).  None while no collective site was traced."""
+    if not _collectives:
+        return None
+    sites = {}
+    phase_bytes: Dict[str, int] = {}
+    for site, rec in sorted(_collectives.items()):
+        phase = rec.get("phase")
+        # collectives are recorded once per TRACE, but the cached program
+        # replays them on every execution of its phase span — scale by
+        # the phase's span count so the bytes (and therefore the attained
+        # rate against the phase's ACCUMULATED seconds) cover the whole
+        # run, mirroring costmodel's per-execution call counter.  A
+        # re-trace (new shapes) double-counts both traced_calls and one
+        # execution — an estimate, as documented in the block's note.
+        execs = max(_phase_counts.get(phase, 1), 1) if phase else 1
+        est_calls = rec["traced_calls"] * rec["loop"] * execs
+        est_bytes = rec["bytes_per_call"] * est_calls
+        entry = {
+            "kind": rec["kind"], "axis": rec["axis"],
+            "bytes_per_call": int(rec["bytes_per_call"]),
+            "traced_calls": int(rec["traced_calls"]),
+            "phase_executions": int(execs),
+            "est_calls": int(est_calls),
+            "est_bytes": int(est_bytes),
+        }
+        if phase:
+            entry["phase"] = phase
+            phase_bytes[phase] = phase_bytes.get(phase, 0) + est_bytes
+            secs = _phase_times.get(phase, 0.0)
+            if secs > 0:
+                entry["attained_gb_per_s"] = round(est_bytes / secs / 1e9, 6)
+        sites[site] = entry
+    phases = {}
+    for phase, nbytes in sorted(phase_bytes.items()):
+        secs = _phase_times.get(phase, 0.0)
+        phases[phase] = {
+            "est_bytes": int(nbytes),
+            "span_seconds": round(secs, 6),
+            "attained_gb_per_s": (round(nbytes / secs / 1e9, 6)
+                                  if secs > 0 else None),
+        }
+    return {"sites": sites, "phases": phases, "fenced_spans": _fence,
+            "note": "logical payload bytes; est_calls = traced x loop x "
+                    "phase executions"}
+
+
+# ------------------------------------------------- timeline / clock offset
+
+def set_timeline(on: bool) -> None:
+    """Arm/disarm per-process shard mode (the ``timeline=`` option).
+    Takes effect at the next sink open; an already-open sink keeps its
+    target (retarget via enable(jsonl_path=...))."""
+    global _timeline
+    _timeline = bool(on)
+    _update_ring_armed()
+
+
+def timeline_enabled() -> bool:
+    return _timeline
+
+
+def set_clock_offset(offset_s: float, rtt_s: Optional[float] = None) -> None:
+    """Install the leader-relative clock offset measured by
+    parallel/mesh.clock_handshake: seconds to ADD to this host's
+    time.time() to land on the leader's clock (recorded in the shard
+    header; scripts/timeline_report.py applies it when merging)."""
+    global _clock_offset, _clock_rtt
+    _clock_offset = float(offset_s)
+    _clock_rtt = None if rtt_s is None else float(rtt_s)
+
+
+def clock_offset() -> float:
+    return _clock_offset
+
+
+_shard_identity: "Optional[tuple[int, int]]" = None
+
+
+def set_shard_identity(index: Optional[int] = None,
+                       count: Optional[int] = None) -> None:
+    """Override the (process_index, process_count) shard identity —
+    dryrun_multichip and tests use it to exercise the multi-shard merge
+    path from a single process (simulated hosts).  ``None`` resets to
+    the real jax.process_index()/count()."""
+    global _shard_identity
+    _shard_identity = (None if index is None or count is None
+                       else (int(index), int(count)))
+
+
+def _shard_suffix() -> "tuple[int, int]":
+    if _shard_identity is not None:
+        return _shard_identity
+    try:
+        import jax
+        return jax.process_index(), jax.process_count()
+    except Exception:
+        return 0, 1
+
+
+def shard_path(base: str, index: int, count: int) -> str:
+    """Deterministic per-process shard name: each process owns exactly
+    one file for the run (no appends to another process's half-written
+    shard), and scripts/timeline_report.py can glob
+    ``<base>.shard-*.jsonl``."""
+    return "%s.shard-%05dof%05d.jsonl" % (base, index, count)
+
+
+def sink_path() -> Optional[str]:
+    """The path records actually land in (the shard path in timeline
+    mode) — test/report helper."""
+    return _shard_path_used if _timeline else _sink_path
+
+
+# ------------------------------------------ flight recorder + stall watchdog
+
+def _update_ring_armed() -> None:
+    global _ring_armed
+    _ring_armed = _timeline or _wd_thread is not None
+
+
+def _ring_event(kind: str, name: str) -> None:
+    """Append one event to the flight-recorder ring (and feed the stall
+    watchdog's progress clock).  Hot-path cost: one deque append."""
+    global _wd_last
+    _ring.append((time.time(), kind, name,
+                  _wd_context.get("iteration")))
+    if _wd_thread is not None:
+        _wd_last = _wd_clock()
+
+
+def configure_watchdog(timeout_s: float) -> None:
+    """Store the ``stall_timeout=`` setting; gbdt.run_training arms the
+    watchdog around training when this is > 0."""
+    global _wd_timeout_cfg
+    _wd_timeout_cfg = max(float(timeout_s), 0.0)
+
+
+def watchdog_configured() -> float:
+    return _wd_timeout_cfg
+
+
+def watchdog_checkin(phase: Optional[str] = None,
+                     iteration: Optional[int] = None,
+                     detail: Optional[str] = None) -> None:
+    """Mark forward progress (and the in-flight context the dump will
+    name).  Called by the boosting loop at phase boundaries; span
+    enter/exit events check in implicitly via the ring."""
+    global _wd_last
+    if phase is not None:
+        _wd_context["phase"] = phase
+    if iteration is not None:
+        _wd_context["iteration"] = int(iteration)
+    if detail is not None:
+        _wd_context["detail"] = detail
+    if _wd_thread is not None:
+        _wd_last = _wd_clock()
+
+
+def arm_watchdog(timeout_s: Optional[float] = None, clock=None,
+                 poll_s: float = 0.05) -> bool:
+    """Start the stall-watchdog thread (idempotent).  ``clock`` is
+    injectable — tests drive a fake clock and never wait out a real
+    stall.  The thread polls a monotonic clock and, once no ring
+    event/checkin lands for ``timeout_s``, writes a flight-recorder
+    dump to the sink (the opaque runtime watchdog is expected to kill a
+    truly hung job shortly after; the dump is the record it never
+    leaves).  If progress RESUMES after a dump — e.g. the stall was a
+    long backend compile, which blocks the host with no events — the
+    watchdog re-arms, up to ``_WD_MAX_DUMPS`` dumps per arming."""
+    global _wd_thread, _wd_stop, _wd_clock, _wd_timeout, _wd_last, _wd_dump
+    timeout = _wd_timeout_cfg if timeout_s is None else float(timeout_s)
+    if timeout <= 0 or _wd_thread is not None:
+        return False
+    _wd_clock = clock or time.monotonic
+    _wd_timeout = timeout
+    _wd_last = _wd_clock()
+    _wd_dump = None
+    _wd_stop = threading.Event()
+    _wd_thread = threading.Thread(
+        target=_wd_run, args=(_wd_stop, poll_s), name="lgbm-tpu-watchdog",
+        daemon=True)
+    _wd_thread.start()
+    _update_ring_armed()
+    return True
+
+
+def disarm_watchdog(join_s: float = 2.0) -> None:
+    global _wd_thread, _wd_stop
+    t, ev = _wd_thread, _wd_stop
+    _wd_thread, _wd_stop = None, None
+    _update_ring_armed()
+    if ev is not None:
+        ev.set()
+    if t is not None and t.is_alive():
+        t.join(join_s)
+
+
+def watchdog_active() -> bool:
+    """True while the watchdog thread is running (tests/conftest.py leak
+    guard)."""
+    return _wd_thread is not None and _wd_thread.is_alive()
+
+
+def last_flight_record() -> Optional[dict]:
+    return _wd_dump
+
+
+# a long backend compile blocks the host with no Python events and can
+# fire a spurious dump; the watchdog therefore RE-ARMS when progress
+# resumes (capped, so a genuinely hung run can't spam the sink) instead
+# of retiring on its first dump — a later real hang still gets recorded
+_WD_MAX_DUMPS = 3
+
+
+def _wd_run(stop: "threading.Event", poll_s: float) -> None:
+    dumps = 0
+    dumped_at: Optional[float] = None   # _wd_last value at the last dump
+    while not stop.is_set():
+        stop.wait(poll_s)
+        try:
+            if dumped_at is not None:
+                if _wd_last > dumped_at:
+                    dumped_at = None    # progress resumed: re-arm
+                else:
+                    continue
+            if _wd_clock() - _wd_last >= _wd_timeout > 0:
+                _flight_dump(_wd_clock() - _wd_last, dumps + 1)
+                dumps += 1
+                dumped_at = _wd_last
+                if dumps >= _WD_MAX_DUMPS:
+                    return
+        except Exception:  # pragma: no cover - never kill the host loop
+            return
+
+
+def _flight_dump(stalled_s: float, dump_index: int = 1) -> None:
+    """Assemble and write the flight-recorder dump: in-flight
+    phase/iteration/collective, the event ring, and every thread's
+    stack.  Pure host-side state reads — never touches device APIs (the
+    device is exactly what's presumed hung)."""
+    global _wd_dump
+    import sys
+    events = [{"t": round(t, 6), "kind": k, "name": n,
+               "iter": it} for (t, k, n, it) in list(_ring)]
+    in_flight_phase = (_span_stack[-1] if _span_stack
+                       else _wd_context.get("phase"))
+    last_coll = next((e["name"] for e in reversed(events)
+                      if e["kind"] == "collective"), None)
+    threads = {}
+    try:
+        names = {t.ident: t.name for t in threading.enumerate()}
+        for tid, frame in sys._current_frames().items():
+            name = names.get(tid, str(tid))
+            if name == "lgbm-tpu-watchdog":
+                continue
+            threads[name] = [ln.rstrip() for ln in
+                             traceback.format_stack(frame)[-8:]]
+    except Exception:
+        pass
+    dump = {
+        "flight_recorder": {
+            "dump_index": int(dump_index),
+            "stalled_for_s": round(float(stalled_s), 3),
+            "stall_timeout_s": _wd_timeout,
+            "phase": in_flight_phase,
+            "iteration": _wd_context.get("iteration"),
+            "detail": _wd_context.get("detail"),
+            "last_collective": last_coll,
+            "open_spans": list(_span_stack),
+            "ring": events[-_RING_CAP:],
+            "threads": threads,
+        }
+    }
+    _wd_dump = dump
+    try:
+        from .utils import log
+        log.warning(
+            "telemetry watchdog: no progress for %.1fs (stall_timeout=%.1fs)"
+            " — in-flight phase=%s iter=%s collective=%s; flight-recorder "
+            "dump written"
+            % (stalled_s, _wd_timeout, in_flight_phase,
+               _wd_context.get("iteration"), last_coll))
+    except Exception:
+        pass
+    try:
+        write_record(dump)
+    except Exception:
+        pass
+
+
 # ------------------------------------------------------------------- spans
 
 class _NullSpan:
@@ -442,6 +900,8 @@ class Span:
         if _memory and not self._is_trace:
             self._mem0 = _mem_sample()
         _span_stack.append(self.name)
+        if _ring_armed:
+            _ring_event("span_enter", self.name)
         self._t0 = time.perf_counter()
         return self
 
@@ -480,6 +940,8 @@ class Span:
             self._mem0 = None
         if _span_stack and _span_stack[-1] == self.name:
             _span_stack.pop()
+        if _ring_armed:
+            _ring_event("span_exit", self.name)
         if self._is_trace:
             _trace_times[self.name] = _trace_times.get(self.name, 0.0) + dt
         else:
@@ -612,6 +1074,9 @@ def snapshot() -> dict:
     mem = memory_snapshot()
     if mem is not None:
         out["memory"] = mem
+    ic = interconnect_snapshot()
+    if ic is not None:
+        out["interconnect"] = ic
     _attach_cost_blocks(out)
     return out
 
@@ -651,25 +1116,71 @@ def take_phase_deltas() -> "tuple[Dict[str, float], Dict[str, float]]":
 
 def _ensure_sink():
     """Open the sink on first write.  Deferred so jax.process_index() is
-    consulted AFTER distributed init: only the leader writes."""
-    global _sink_file, _sink_error
+    consulted AFTER distributed init: only the leader writes — unless
+    timeline mode is on, in which case EVERY process opens its own shard
+    (deterministic per-process name; line-buffered, so a killed process
+    leaves at worst one truncated final line) and writes a ``shard``
+    header record first."""
+    global _sink_file, _sink_error, _shard_path_used
     if _sink_file is not None or _sink_path is None or _sink_error:
         return _sink_file
+    path = _sink_path
+    header = None
+    if _timeline:
+        idx, count = _shard_suffix()
+        path = _shard_path_used = shard_path(_sink_path, idx, count)
+        header = _shard_header(idx, count)
+    else:
+        try:
+            import jax
+            if jax.process_count() > 1 and jax.process_index() != 0:
+                _sink_error = True   # non-leader: never write
+                return None
+        except Exception:
+            pass
     try:
-        import jax
-        if jax.process_count() > 1 and jax.process_index() != 0:
-            _sink_error = True   # non-leader: never write
-            return None
-    except Exception:
-        pass
-    try:
-        _sink_file = open(_sink_path, "w")
+        # line-buffered: each record reaches the OS at its newline, so a
+        # crashed peer's shard is readable up to its last whole record
+        _sink_file = open(path, "w", buffering=1)
     except OSError:
         from .utils import log
         log.warning("telemetry: cannot open metrics_out=%s; sink disabled"
-                    % _sink_path)
+                    % path)
         _sink_error = True
+        return None
+    if header is not None:
+        try:
+            _sink_file.write(json.dumps(header) + "\n")
+            _sink_file.flush()
+        except OSError:
+            pass
     return _sink_file
+
+
+def _shard_header(idx: int, count: int) -> dict:
+    """The shard's self-describing first record: which host/process wrote
+    it, and the clock offset that maps its local ``t`` stamps onto the
+    leader's clock."""
+    import socket
+    info = {
+        "process_index": int(idx),
+        "process_count": int(count),
+        "pid": os.getpid(),
+        "clock_offset_s": round(_clock_offset, 6),
+        "started_unix": round(time.time(), 6),
+    }
+    if _clock_rtt is not None:
+        info["clock_rtt_s"] = round(_clock_rtt, 6)
+    try:
+        info["host"] = socket.gethostname()
+    except Exception:
+        info["host"] = "unknown"
+    try:
+        from . import costmodel
+        info["fingerprint"] = costmodel.host_fingerprint()
+    except Exception:
+        pass
+    return {"shard": info}
 
 
 def _round_times(d: Dict[str, float]) -> Dict[str, float]:
@@ -721,6 +1232,13 @@ def emit_iteration(iteration: int, phase_times: Dict[str, float],
         "counters": dict(sorted(_counters.items())),
         "eval_metrics": eval_metrics or {},
     }
+    if _timeline:
+        # local wall clock; the shard header's clock_offset_s maps it
+        # onto the leader's clock when timeline_report merges shards
+        record["t"] = round(time.time(), 6)
+    if _ring_armed:
+        _ring_event("iteration", str(iteration))
+    watchdog_checkin(iteration=iteration)
     if trace_times:
         record["trace_times"] = _round_times(trace_times)
     if health is not None:
@@ -744,9 +1262,14 @@ def emit_summary(extra: Optional[dict] = None) -> dict:
         "trace_times": _round_times(_trace_times),
         "counters": dict(sorted(_counters.items())),
     }
+    if _timeline:
+        record["t"] = round(time.time(), 6)
     mem = memory_snapshot()
     if mem is not None:
         record["memory"] = mem
+    ic = interconnect_snapshot()
+    if ic is not None:
+        record["interconnect"] = ic
     _attach_cost_blocks(record)
     if extra:
         record.update(extra)

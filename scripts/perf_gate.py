@@ -15,7 +15,15 @@ round against the earlier trajectory:
   ``frac_of_peak_bw`` per phase, when present — a throughput number can
   hide a kernel regression behind a faster host, the attained fraction
   cannot;
-- **multichip**: a round whose smoke run went ok -> not-ok.
+- **multichip**: a round whose smoke run went ok -> not-ok, plus the
+  ISSUE-5 distributed-observability trajectory: the ``skew`` block's
+  ``max_phase_skew`` (cross-host per-phase dispersion must not grow
+  beyond the noise band — a growing ratio is a new straggler or an
+  unbalanced schedule) and the ``interconnect`` attained GB/s (must not
+  drop — a collective-route regression hides behind a healthy ok flag).
+  The block is read from the record itself or parsed out of the smoke
+  run's ``tail`` (dryrun_multichip prints one ``MULTICHIP_OBS`` JSON
+  line).
 
 Entries are grouped by their ``metric`` name (an 11M round is never
 compared to a 1M round) and, when the ``host`` block is present
@@ -55,6 +63,9 @@ RATE_KEYS: Tuple[Tuple[str, str], ...] = (
 
 DEFAULT_FLOOR = 0.02      # minimum relative noise band when none recorded
 DEFAULT_SIGMA_MULT = 3.0
+# noise-band floor for the multichip skew/interconnect series (no
+# recorded spread; tiny smoke runs -> timing-noise-dominated)
+_OBS_FLOOR = 0.5
 
 
 class GateError(Exception):
@@ -84,11 +95,38 @@ def load_entry(path: str) -> dict:
         rec, kind = data, "bench"
     elif "n_devices" in data or "ok" in data:
         rec, kind = data, "multichip"
+        _attach_multichip_obs(rec)
     else:
         raise GateError(f"{path}: unrecognized bench record "
                         "(no 'parsed', 'metric' or multichip keys)")
     return {"kind": kind, "round": _round_of(path, data), "rec": rec,
             "path": path}
+
+
+def _attach_multichip_obs(rec: dict) -> None:
+    """Surface the distributed-observability block on a multichip record:
+    either already present as ``skew``/``interconnect`` keys, or parsed
+    from the smoke run's captured ``tail`` (dryrun_multichip prints one
+    ``MULTICHIP_OBS <json>`` line).  Malformed/absent lines leave the
+    record untouched — pre-ISSUE-5 rounds simply have no obs series."""
+    if "skew" in rec:
+        return
+    tail = rec.get("tail")
+    if not isinstance(tail, str):
+        return
+    for line in reversed(tail.splitlines()):
+        line = line.strip()
+        if not line.startswith("MULTICHIP_OBS "):
+            continue
+        try:
+            obs = json.loads(line[len("MULTICHIP_OBS "):])
+        except ValueError:
+            return
+        if isinstance(obs, dict):
+            for key in ("skew", "interconnect", "simulated_hosts"):
+                if key in obs:
+                    rec[key] = obs[key]
+        return
 
 
 def _fractions(rec: dict) -> Dict[str, float]:
@@ -170,7 +208,29 @@ def _check_group(metric: str, entries: List[dict], floor: float,
             })
 
 
-def _check_multichip(entries: List[dict], findings: List[dict]) -> None:
+def _multichip_obs_value(rec: dict, key: str) -> Optional[float]:
+    """The two gated observability series on a multichip record."""
+    if key == "skew/max_phase_skew":
+        skew = rec.get("skew")
+        if isinstance(skew, dict) and isinstance(
+                skew.get("max_phase_skew"), (int, float)):
+            # a round that compared no iterations has no skew signal
+            if skew.get("iterations_compared", 0) > 0 \
+                    and skew["max_phase_skew"] > 0:
+                return float(skew["max_phase_skew"])
+        return None
+    if key == "interconnect/attained_gb_per_s":
+        ic = rec.get("interconnect")
+        if isinstance(ic, dict) and isinstance(
+                ic.get("attained_gb_per_s"), (int, float)) \
+                and ic["attained_gb_per_s"] > 0:
+            return float(ic["attained_gb_per_s"])
+    return None
+
+
+def _check_multichip(entries: List[dict], findings: List[dict],
+                     floor: float = DEFAULT_FLOOR,
+                     sigma_mult: float = DEFAULT_SIGMA_MULT) -> None:
     entries = sorted(entries, key=lambda e: e["round"])
     if len(entries) < 2:
         return
@@ -183,6 +243,41 @@ def _check_multichip(entries: List[dict], findings: List[dict]) -> None:
             "latest": False, "baseline": True,
             "detail": "multichip smoke went ok -> not-ok",
         })
+    # ISSUE 5: the skew/interconnect trajectory.  No recorded spread for
+    # these series, and the smoke runs are tiny (compile warmth and host
+    # load dominate — the simulated-host skew legitimately swings ~2x),
+    # so the band floor is wide: these series catch ORDER-OF-MAGNITUDE
+    # breaks (a collective route regression, a new persistent straggler),
+    # not percent drift.  sigma = band/2 like the rate keys.
+    sigma = max(floor, _OBS_FLOOR) / 2.0
+    for key, direction in (("skew/max_phase_skew", "up"),
+                           ("interconnect/attained_gb_per_s", "down")):
+        series = [(e["round"], _multichip_obs_value(e["rec"], key))
+                  for e in entries]
+        series = [(r, v) for r, v in series if v is not None]
+        if len(series) < 2 or series[-1][0] != latest["round"]:
+            continue
+        prior = [v for _, v in series[:-1]]
+        latest_v = series[-1][1]
+        baseline = _median(prior)
+        if baseline <= 0:
+            continue
+        if direction == "up":
+            threshold = baseline * (1.0 + sigma_mult * sigma)
+            regressed = latest_v > threshold
+            drop = latest_v / baseline - 1.0
+        else:
+            threshold = baseline * (1.0 - sigma_mult * sigma)
+            regressed = latest_v < threshold
+            drop = 1.0 - latest_v / baseline
+        if regressed:
+            findings.append({
+                "metric": "multichip", "key": key,
+                "latest_round": latest["round"],
+                "latest": latest_v, "baseline": round(baseline, 6),
+                "drop": round(drop, 4),
+                "allowed_drop": round(sigma_mult * sigma, 4),
+            })
 
 
 def check_files(paths: List[str], floor: float = DEFAULT_FLOOR,
@@ -205,7 +300,8 @@ def check_files(paths: List[str], floor: float = DEFAULT_FLOOR,
     for metric, group in sorted(groups.items()):
         _check_group(metric, group, floor, sigma_mult,
                      allow_cross_hardware, findings)
-    _check_multichip(multichip, findings)
+    _check_multichip(multichip, findings, floor=floor,
+                     sigma_mult=sigma_mult)
     return {
         "files": len(entries),
         "groups": {m: len(g) for m, g in sorted(groups.items())},

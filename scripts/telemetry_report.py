@@ -163,6 +163,41 @@ def _roofline_lines(roofline):
     return out
 
 
+def _interconnect_lines(ic):
+    """Per-collective-site wire-metrics table (ISSUE 5 ``interconnect``
+    block): logical payload bytes and attained GB/s per site/phase."""
+    out = ["Interconnect (per-collective wire metrics)",
+           "------------------------------------------"]
+    if not ic or not ic.get("sites"):
+        out.append("(no interconnect block — emitted by multi-device "
+                   "runs with collective seams traced)")
+        return out
+    sites = ic["sites"]
+    width = max(len(s) for s in sites)
+    out.append(f"{'site'.ljust(width)}  {'kind':>12}  {'bytes/call':>12}  "
+               f"{'est calls':>9}  {'est bytes':>12}  {'GB/s':>10}")
+    for name, blk in sorted(sites.items(),
+                            key=lambda kv: -kv[1].get("est_bytes", 0)):
+        rate = blk.get("attained_gb_per_s")
+        out.append(
+            f"{name.ljust(width)}  {blk.get('kind', '?'):>12}  "
+            f"{_fmt_bytes(blk.get('bytes_per_call', 0)):>12}  "
+            f"{blk.get('est_calls', 0):>9}  "
+            f"{_fmt_bytes(blk.get('est_bytes', 0)):>12}  "
+            + (f"{rate:>10.4f}" if isinstance(rate, (int, float))
+               else f"{'-':>10}"))
+    for phase, blk in sorted((ic.get("phases") or {}).items()):
+        rate = blk.get("attained_gb_per_s")
+        out.append("phase %-12s  %s over %.4fs span -> %s GB/s"
+                   % (phase, _fmt_bytes(blk.get("est_bytes", 0)),
+                      blk.get("span_seconds", 0.0),
+                      ("%.4f" % rate) if isinstance(rate, (int, float))
+                      else "-"))
+    if ic.get("note"):
+        out.append("note: %s" % ic["note"])
+    return out
+
+
 def _compile_lines(comp):
     out = ["Compile observability", "---------------------"]
     if not comp:
@@ -226,6 +261,7 @@ def report(path: str, as_json: bool = False) -> int:
 
     roofline = (summary or {}).get("roofline")
     comp = (summary or {}).get("compile")
+    interconnect = (summary or {}).get("interconnect")
 
     if as_json:
         print(json.dumps({
@@ -240,6 +276,7 @@ def report(path: str, as_json: bool = False) -> int:
             "residency": residency or {},
             "roofline": roofline or {},
             "compile": comp or {},
+            "interconnect": interconnect or {},
             "eval_first_last": {k: [v[0], v[-1]]
                                 for k, v in sorted(evals.items())},
         }))
@@ -299,6 +336,8 @@ def report(path: str, as_json: bool = False) -> int:
             out.append(f"  {k.ljust(width)}  {val:>12}")
     out.append("")
     out += _roofline_lines(roofline)
+    out.append("")
+    out += _interconnect_lines(interconnect)
     out.append("")
     out += _compile_lines(comp)
     if evals:

@@ -41,12 +41,22 @@ def _telemetry_leak_guard():
     yield
     leaked_enabled = telemetry.enabled()
     leaked_sink = telemetry.sink_open()
+    # ISSUE 5 surfaces: a live watchdog thread keeps polling (and could
+    # dump into a LATER test's sink); timeline/shard mode left on makes
+    # the next metrics_out test write an unexpected shard file instead
+    # of its configured path (an unmerged shard surviving the test)
+    leaked_watchdog = telemetry.watchdog_active()
+    leaked_timeline = telemetry.timeline_enabled()
     telemetry.disable()
     telemetry.reset()
-    assert not (leaked_enabled or leaked_sink), (
+    assert not (leaked_enabled or leaked_sink or leaked_watchdog
+                or leaked_timeline), (
         "test left telemetry %s — disable() it (or use a fixture) so "
         "state cannot leak between tests"
-        % ("enabled with an open sink" if leaked_sink else "enabled"))
+        % ("with a live watchdog thread" if leaked_watchdog
+           else "in timeline/shard mode" if leaked_timeline
+           else "enabled with an open sink" if leaked_sink
+           else "enabled"))
 
 
 @pytest.fixture(scope="session")

@@ -125,6 +125,71 @@ def test_multichip_ok_to_notok_flagged(tmp_path):
     assert any(f["metric"] == "multichip" for f in report["findings"])
 
 
+# ----------------------------------------- multichip skew / interconnect gate
+
+def _write_multichip(tmp_path, n, skew=None, gbps=None, via_tail=False):
+    rec = {"n_devices": 8, "rc": 0, "ok": True}
+    obs = {}
+    if skew is not None:
+        obs["skew"] = {"max_phase_skew": skew, "iterations_compared": 3,
+                       "phases": {"grow": {"max_skew": skew}}}
+    if gbps is not None:
+        obs["interconnect"] = {"sites": 4, "est_bytes_total": 4000,
+                               "attained_gb_per_s": gbps}
+    if via_tail:
+        rec["tail"] = ("[LightGBM] [Info] whatever\nMULTICHIP_OBS "
+                       + json.dumps(obs) + "\n")
+    else:
+        rec.update(obs)
+    path = tmp_path / f"MULTICHIP_r{n:02d}.json"
+    path.write_text(json.dumps(rec))
+    return str(path)
+
+
+def test_multichip_skew_growth_flagged(tmp_path):
+    """ISSUE 5: a latest round whose max per-phase skew grows past the
+    (wide, order-of-magnitude) noise band — a new straggler or an
+    unbalanced schedule — is a regression even with the ok flag green."""
+    paths = [_write_multichip(tmp_path, n, skew=s)
+             for n, s in enumerate([1.2, 1.21, 1.19, 4.5], start=1)]
+    report = perf_gate.check_files(paths)
+    keys = [f["key"] for f in report["findings"]]
+    assert "skew/max_phase_skew" in keys
+
+
+def test_multichip_interconnect_drop_flagged(tmp_path):
+    paths = [_write_multichip(tmp_path, n, gbps=g)
+             for n, g in enumerate([4.0, 4.05, 3.98, 0.4], start=1)]
+    report = perf_gate.check_files(paths)
+    keys = [f["key"] for f in report["findings"]]
+    assert "interconnect/attained_gb_per_s" in keys
+
+
+def test_multichip_obs_stable_passes(tmp_path):
+    paths = [_write_multichip(tmp_path, n, skew=s, gbps=g)
+             for n, (s, g) in enumerate(
+                 [(1.2, 4.0), (1.21, 4.02), (1.19, 3.99)], start=1)]
+    assert perf_gate.check_files(paths)["findings"] == []
+
+
+def test_multichip_obs_parsed_from_tail(tmp_path):
+    """dryrun_multichip prints one MULTICHIP_OBS JSON line; the gate reads
+    the block out of the captured tail when the wrapper did not lift it."""
+    paths = [_write_multichip(tmp_path, n, skew=s, via_tail=True)
+             for n, s in enumerate([1.2, 1.21, 4.8], start=1)]
+    report = perf_gate.check_files(paths)
+    keys = [f["key"] for f in report["findings"]]
+    assert "skew/max_phase_skew" in keys
+
+
+def test_multichip_rounds_without_obs_are_not_compared(tmp_path):
+    """Pre-ISSUE-5 rounds (no skew block) must not break the gate or
+    read as regressions against obs-carrying rounds."""
+    paths = [_write_multichip(tmp_path, 1),
+             _write_multichip(tmp_path, 2, skew=1.2, gbps=4.0)]
+    assert perf_gate.check_files(paths)["findings"] == []
+
+
 def test_malformed_file_is_a_one_line_error(tmp_path):
     p = tmp_path / "BENCH_r01.json"
     p.write_text("{not json")

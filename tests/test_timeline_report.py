@@ -1,0 +1,175 @@
+"""scripts/timeline_report.py tests: shard merge under deliberate clock
+offsets, per-phase skew attribution, the persistent-straggler flag,
+crash-tail tolerance vs mid-file corruption, and the Perfetto export."""
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+spec = importlib.util.spec_from_file_location(
+    "timeline_report", os.path.join(REPO, "scripts", "timeline_report.py"))
+tr = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(tr)
+
+
+def _write_shard(path, index, count, offset, records, host="hostA",
+                 truncate_tail=False):
+    with open(path, "w") as f:
+        f.write(json.dumps({"shard": {
+            "process_index": index, "process_count": count,
+            "pid": 1000 + index, "clock_offset_s": offset,
+            "host": host, "started_unix": 0.0}}) + "\n")
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+        if truncate_tail:
+            f.write('{"iter": 99, "phase_times"')   # killed mid-write
+    return path
+
+
+def _iter_rec(it, t, phase_times, **extra):
+    rec = {"iter": it, "phase_times": phase_times, "counters": {},
+           "eval_metrics": {}, "t": t}
+    rec.update(extra)
+    return rec
+
+
+def test_merge_applies_clock_offsets_round_trip(tmp_path):
+    """Host B's clock runs 100 s AHEAD of the leader (offset -100 maps it
+    back).  True event order (leader clock): A1, B1, A2, B2 — raw local
+    stamps would order every B event after every A event."""
+    a = _write_shard(str(tmp_path / "s0"), 0, 2, 0.0, [
+        _iter_rec(1, 10.0, {"histogram": 0.5}),
+        _iter_rec(2, 12.0, {"histogram": 0.5}),
+    ], host="A")
+    b = _write_shard(str(tmp_path / "s1"), 1, 2, -100.0, [
+        _iter_rec(1, 111.0, {"histogram": 0.5}),
+        _iter_rec(2, 113.0, {"histogram": 0.5}),
+    ], host="B")
+    shards = [tr.load_shard(p) for p in (a, b)]
+    events = tr.merge_timeline(shards)
+    order = [(e["_host"], e["iter"]) for e in events]
+    assert order == [("p0@A", 1), ("p1@B", 1), ("p0@A", 2), ("p1@B", 2)]
+    assert [round(e["_t"], 3) for e in events] == [10.0, 11.0, 12.0, 13.0]
+
+
+def test_skew_table_flags_slow_phase_and_straggler(tmp_path):
+    """Host B is consistently 3x slower in histogram: max_phase_skew must
+    price it and the persistent-straggler flag must name B after K
+    consecutive slowest iterations."""
+    iters = 4
+    a = _write_shard(str(tmp_path / "s0"), 0, 2, 0.0, [
+        _iter_rec(i, float(i), {"histogram": 0.1, "split_find": 0.05})
+        for i in range(1, iters + 1)], host="A")
+    b = _write_shard(str(tmp_path / "s1"), 1, 2, 0.0, [
+        _iter_rec(i, float(i), {"histogram": 0.3, "split_find": 0.05})
+        for i in range(1, iters + 1)], host="B")
+    shards = [tr.load_shard(p) for p in (a, b)]
+    skew = tr.skew_report(shards, straggler_k=3)
+    assert skew["iterations_compared"] == iters
+    assert skew["phases"]["histogram"]["max_skew"] == pytest.approx(1.5)
+    assert skew["phases"]["split_find"]["max_skew"] == pytest.approx(1.0)
+    assert skew["max_phase_skew"] == pytest.approx(1.5)
+    assert skew["persistent_straggler"] == "p1@B"
+    # A waits 0.2 s per iteration for B at the collectives
+    assert skew["barrier_wait_s"]["p0@A"] == pytest.approx(0.2 * iters)
+    assert skew["barrier_wait_s"]["p1@B"] == 0.0
+
+
+def test_no_straggler_when_slowest_alternates(tmp_path):
+    recs_a, recs_b = [], []
+    for i in range(1, 7):
+        slow_a = 0.3 if i % 2 else 0.1
+        slow_b = 0.1 if i % 2 else 0.3
+        recs_a.append(_iter_rec(i, float(i), {"histogram": slow_a}))
+        recs_b.append(_iter_rec(i, float(i), {"histogram": slow_b}))
+    a = _write_shard(str(tmp_path / "s0"), 0, 2, 0.0, recs_a, host="A")
+    b = _write_shard(str(tmp_path / "s1"), 1, 2, 0.0, recs_b, host="B")
+    skew = tr.skew_report([tr.load_shard(p) for p in (a, b)],
+                          straggler_k=3)
+    assert skew["persistent_straggler"] is None
+
+
+def test_truncated_tail_tolerated_midfile_corruption_rejected(tmp_path):
+    ok = _write_shard(str(tmp_path / "s0"), 0, 1, 0.0,
+                      [_iter_rec(1, 1.0, {"histogram": 0.1})],
+                      truncate_tail=True)
+    shard = tr.load_shard(ok)
+    assert shard["truncated"] and len(shard["records"]) == 1
+
+    bad = str(tmp_path / "s1")
+    with open(bad, "w") as f:
+        f.write('{"iter": 1, "phase_times"\n')      # corrupt MID-file
+        f.write(json.dumps(_iter_rec(2, 2.0, {})) + "\n")
+    with pytest.raises(tr.ReportError):
+        tr.load_shard(bad)
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    a = _write_shard(str(tmp_path / "s0"), 0, 2, 0.0, [
+        _iter_rec(i, float(i), {"histogram": 0.1}) for i in range(1, 5)],
+        host="A")
+    b = _write_shard(str(tmp_path / "s1"), 1, 2, 0.0, [
+        _iter_rec(i, float(i), {"histogram": 0.4}) for i in range(1, 5)],
+        host="B")
+    # persistent straggler -> exit 1; report names it
+    assert tr.main([a, b]) == 1
+    out = capsys.readouterr().out
+    assert "PERSISTENT STRAGGLER" in out and "p1@B" in out
+    # no shards -> exit 2
+    assert tr.main([str(tmp_path / "nope-*")]) == 2
+    # healthy pair -> exit 0 with a skew table
+    c = _write_shard(str(tmp_path / "s2"), 1, 2, 0.0, [
+        _iter_rec(i, float(i), {"histogram": 0.1}) for i in range(1, 5)],
+        host="C")
+    assert tr.main([a, c]) == 0
+    assert "per-phase cross-host skew" in capsys.readouterr().out
+
+
+def test_json_report_and_glob(tmp_path, capsys):
+    a = _write_shard(str(tmp_path / "r.jsonl.shard-00000of00002.jsonl"),
+                     0, 2, 0.0,
+                     [_iter_rec(1, 1.0, {"histogram": 0.1})], host="A")
+    _write_shard(str(tmp_path / "r.jsonl.shard-00001of00002.jsonl"),
+                 1, 2, 0.0,
+                 [_iter_rec(1, 1.0, {"histogram": 0.2})], host="B")
+    assert tr.main(["--glob", str(tmp_path / "r.jsonl.shard-*"),
+                    "--json"]) == 0
+    skew = json.loads(capsys.readouterr().out)
+    assert skew["iterations_compared"] == 1
+    assert skew["phases"]["histogram"]["max_skew"] == pytest.approx(
+        4 / 3, rel=1e-3)
+
+
+def test_perfetto_export(tmp_path):
+    a = _write_shard(str(tmp_path / "s0"), 0, 2, 0.0, [
+        _iter_rec(1, 10.0, {"histogram": 0.5, "eval": 0.25})], host="A")
+    b = _write_shard(str(tmp_path / "s1"), 1, 2, -5.0, [
+        _iter_rec(1, 15.5, {"histogram": 0.5})], host="B")
+    out = str(tmp_path / "trace.json")
+    assert tr.main([a, b, "--perfetto", out]) == 0
+    trace = json.load(open(out))["traceEvents"]
+    slices = [e for e in trace if e["ph"] == "X"]
+    metas = [e for e in trace if e["ph"] == "M"]
+    assert {m["args"]["name"] for m in metas} == {"p0@A", "p1@B"}
+    assert {s["name"] for s in slices} == {"histogram", "eval"}
+    # host B's slice lands on the leader clock (15.5 - 5.0 = 10.5)
+    b_slice = [s for s in slices if s["pid"] == 1][0]
+    assert b_slice["ts"] + b_slice["dur"] == pytest.approx(10.5e6)
+
+
+def test_wire_decomposition_from_interconnect(tmp_path):
+    summary = {"summary": True, "t": 20.0, "phase_times": {},
+               "interconnect": {"sites": {}, "phases": {
+                   "grow": {"est_bytes": 10 ** 9, "span_seconds": 2.0,
+                            "attained_gb_per_s": 0.5}}}}
+    a = _write_shard(str(tmp_path / "s0"), 0, 2, 0.0, [
+        _iter_rec(1, 1.0, {"histogram": 0.1}), summary], host="A")
+    b = _write_shard(str(tmp_path / "s1"), 1, 2, 0.0, [
+        _iter_rec(1, 1.0, {"histogram": 0.2})], host="B")
+    skew = tr.skew_report([tr.load_shard(p) for p in (a, b)])
+    assert skew["wire"]["est_bytes_total"] == 10 ** 9
+    assert skew["wire"]["attained_gb_per_s"] == pytest.approx(0.5)
