@@ -5,6 +5,15 @@ src/main.cpp.  Same surface: ``task=train|predict``, config files from
 examples/ run unchanged (only ``device_type`` is TPU-specific and optional).
 Distributed runs replace socket/MPI bootstrap (application.cpp:202-205) with
 jax.distributed + a device mesh (lightgbm_tpu/parallel/).
+
+TPU-native training knobs beyond the reference surface (all parsed as
+ordinary ``key=value`` options, see config.py for semantics):
+``grow_policy``, ``hist_dtype``, ``hist_chunk``, ``dp_schedule``,
+``leafwise_compact``, ``leafwise_segments``, ``quant_rounding``,
+``mixed_bin`` (per-bin-width-class histogram passes, ISSUE 6) and
+``pipeline`` (deferred-readback boosting, ISSUE 6).  ``grow_policy`` and
+``hist_dtype`` are documented accuracy/order trades; all the others are
+model-invariant — flipping them changes speed, never trees.
 """
 from __future__ import annotations
 

@@ -368,6 +368,19 @@ class TreeConfig:
     # tests on the masked grower); "true"/"false" force it.  When on it
     # subsumes leafwise_segments: per-tree dispatches are already short.
     leafwise_compact: str = "auto"
+    # mixed-bin feature packing (TreeConfig extension, ISSUE 6): partition
+    # features into bin-width classes at Dataset-attach time (narrow:
+    # num_bin <= 64 rides the measured-fast 64-wide kernel class; wide:
+    # num_bins_max), reorder the bin matrix by class, and run one
+    # histogram pass per class — split outputs are bit-identical to the
+    # uniform single-pass path (per-class histograms are reassembled into
+    # canonical feature order before split finding).  "auto"/"true" = on
+    # whenever the dataset actually mixes narrow and wide features (a
+    # single class collapses to the existing path); "false" = off.
+    # LGBM_TPU_NO_MIXEDBIN=1 is the env A/B hatch.  The feature-parallel
+    # learner keeps the uniform layout (its per-shard ownership slices
+    # are arbitrary feature subsets).
+    mixed_bin: str = "auto"
     # int8 rounding mode: "nearest" (default) or "stochastic" — unbiased
     # floor(y+u) with deterministic value-keyed uniform bits
     # (ops/hist_pallas.stochastic_bits); preserves the serial==distributed
@@ -419,6 +432,11 @@ class TreeConfig:
             log.check(value in ("auto", "psum", "reduce_scatter"),
                       "dp_schedule must be auto, psum or reduce_scatter")
             self.dp_schedule = value
+        if "mixed_bin" in params:
+            value = params["mixed_bin"].lower()
+            log.check(value in ("auto", "true", "false"),
+                      "mixed_bin must be auto, true or false")
+            self.mixed_bin = value
         if "quant_rounding" in params:
             value = params["quant_rounding"].lower()
             log.check(value in ("nearest", "stochastic"),
@@ -458,6 +476,19 @@ class BoostingConfig:
     # eval-metric divergence detection: k consecutive worsening
     # iterations of any tracked metric flag an anomaly (0 = disabled)
     health_divergence_rounds: int = 0
+    # pipelined boosting (ISSUE 6): "readback" double-buffers the next
+    # iteration's (or chunk's) gradient/histogram dispatch against the
+    # current model readback — the device math is dispatched in exactly
+    # the per-iteration order, only HOST WAITS move, so trees/scores/
+    # metric values are exact-identical (tests/test_pipeline.py).  "off"
+    # keeps the strictly synchronous loop.  "auto" = readback inside
+    # run_training for single-process runs without an in-loop checkpoint
+    # callback (a save_fn must see every finished tree, so the CLI's
+    # incremental output_model saves keep auto synchronous; direct
+    # train_one_iter / train_chunk callers keep synchronous semantics
+    # unless they opt in explicitly); multi-process runs stay off.
+    # LGBM_TPU_PIPELINE overrides for A/B timing.
+    pipeline: str = "auto"
     tree_config: TreeConfig = dataclasses.field(default_factory=TreeConfig)
 
     def set(self, params: Dict[str, str]) -> None:
@@ -494,6 +525,11 @@ class BoostingConfig:
             params, "health_divergence_rounds", self.health_divergence_rounds)
         log.check(self.health_divergence_rounds >= 0,
                   "health_divergence_rounds should be >= 0")
+        if "pipeline" in params:
+            value = params["pipeline"].lower()
+            log.check(value in ("auto", "off", "readback"),
+                      "pipeline must be auto, off or readback")
+            self.pipeline = value
         if "tree_learner" in params:
             value = params["tree_learner"].lower()
             if value == "serial":

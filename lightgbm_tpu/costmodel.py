@@ -373,7 +373,15 @@ def note_traced_pass(phase: str, key: tuple, **cost) -> None:
     bytes moved per partition call).  XLA cost analysis cannot see into
     Pallas custom calls, so these notes are the cost model for the
     Pallas-routed phases.  Deduped by static ``key``; ``traces`` counts
-    how many program traces baked this pass in."""
+    how many program traces baked this pass in.
+
+    Mixed-bin packing (ISSUE 6): a histogram level pass over a packed
+    dataset is one pass PER bin-width class, and the routing layer files
+    one note per class with a trailing ``binclass<width>`` key element
+    (ops/histogram._note_hist_pass) — so the roofline block attributes
+    narrow-class and wide-class cost separately instead of pricing every
+    feature at the uniform worst case, and the modeled MAC total shrinks
+    in step with the measured seconds."""
     if not _enabled:
         return
     k = (phase, key)

@@ -14,8 +14,9 @@ lightgbm_tpu/io/dataset.py.
 """
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field
-from typing import List
+from typing import List, NamedTuple, Optional
 
 import numpy as np
 
@@ -159,6 +160,101 @@ class BinMapper:
                               offset=offset).copy()
         return cls(num_bin=num_bin, is_trivial=bool(is_trivial),
                    sparse_rate=sparse_rate, bin_upper_bound=upper)
+
+
+# ---------------------------------------------------------------------------
+# Mixed-bin feature packing (ISSUE 6).
+#
+# The reference pays per-feature bin counts: BinMapper.find_bin emits
+# ``num_bin <= max_bin`` PER FEATURE, and the CPU scatter-add loop touches
+# only the bins a feature actually has.  The TPU one-hot-matmul kernels
+# instead price every feature at the uniform ``num_bins_max`` histogram
+# width — a 3-distinct-value flag column costs the same 255-wide pass as a
+# fully continuous one.  The fix is a LAYOUT decision made once at Dataset
+# build time: partition features into bin-WIDTH classes (narrow: num_bin
+# fits the 64-wide kernel class — the measured-fast ``maxbin63`` shape;
+# wide: everything else at the dataset's num_bins_max), reorder the bin
+# matrix so each class is a contiguous feature block, and run one histogram
+# pass per class.  The per-class histograms are concatenated back into
+# CANONICAL feature order before split finding, so feature indices,
+# argmax tie-breaks, ownership blocks and trees are exactly the uniform
+# path's — a narrow feature's bins beyond its num_bin are all zero in the
+# uniform pass too, so the reassembled histogram is value-identical.
+#
+# The spec is a NamedTuple of plain tuples: hashable, so it rides the
+# growers' jit static args and the chunk-program cache keys.
+
+# bin-width classes: features with num_bin <= NARROW_BINS take the narrow
+# kernel class (one 64-wide histogram pass — the ``maxbin63`` kernel shape
+# measured at 2.6x the 255-wide pass); everything else pays num_bins_max.
+# scripts/hist_kernel_bench.py --sweep-classes re-derives this threshold
+# from measurement when kernel economics change.
+NARROW_BINS = 64
+
+
+class PackSpec(NamedTuple):
+    """Static description of a packed bin-matrix layout.
+
+    widths : per-class histogram width, ascending (e.g. ``(64, 255)``)
+    counts : features per class, same order; ``sum(counts) == F``
+    perm   : packed position -> canonical inner feature index (stable
+             within each class, so the packed order is reproducible)
+    """
+    widths: tuple
+    counts: tuple
+    perm: tuple
+
+    @property
+    def num_features(self) -> int:
+        return len(self.perm)
+
+    @property
+    def ranges(self):
+        """Per-class ``(start, count, width)`` in packed feature order."""
+        out, start = [], 0
+        for cnt, width in zip(self.counts, self.widths):
+            out.append((start, cnt, width))
+            start += cnt
+        return tuple(out)
+
+    @property
+    def c2p(self) -> tuple:
+        """Canonical inner feature index -> packed position (inverse of
+        ``perm``)."""
+        inv = [0] * len(self.perm)
+        for p, f in enumerate(self.perm):
+            inv[f] = p
+        return tuple(inv)
+
+
+def plan_feature_packing(num_bins, num_bins_max: int,
+                         mode: str = "auto",
+                         narrow_bins: int = NARROW_BINS
+                         ) -> Optional[PackSpec]:
+    """Decide the packed layout for a dataset's per-feature bin counts.
+
+    Returns None when packing cannot help — a single bin-width class
+    (every feature wide, or every feature already within the narrow
+    width so ``num_bins_max`` is small anyway) collapses to the existing
+    single-pass path with no layout change at all.  ``mode``:
+    "auto"/"true" enable (auto and true only differ for callers that log
+    the decision), "false" disables.  The ``LGBM_TPU_NO_MIXEDBIN=1`` env
+    hatch forces off for A/B timing without touching configs."""
+    if mode == "false" or os.environ.get("LGBM_TPU_NO_MIXEDBIN", "") == "1":
+        return None
+    nb = np.asarray(num_bins)
+    if nb.size == 0 or num_bins_max <= narrow_bins:
+        return None
+    narrow = nb <= narrow_bins
+    if not narrow.any() or narrow.all():
+        # degenerate: one class only — the uniform path IS the packed
+        # path (all-narrow datasets already ride a small num_bins_max)
+        return None
+    order = np.concatenate([np.nonzero(narrow)[0], np.nonzero(~narrow)[0]])
+    return PackSpec(
+        widths=(int(narrow_bins), int(num_bins_max)),
+        counts=(int(narrow.sum()), int((~narrow).sum())),
+        perm=tuple(int(i) for i in order))
 
 
 def find_bins_for_matrix(sample: np.ndarray, max_bin: int) -> List[BinMapper]:

@@ -556,6 +556,20 @@ class Dataset:
     def num_features(self) -> int:
         return len(self.bin_mappers)
 
+    def plan_packing(self, mode: str = "auto"):
+        """Mixed-bin layout plan for THIS dataset's per-feature bin counts
+        (io/binning.plan_feature_packing): the bin-width-class partition a
+        booster uses to reorder the bin matrix at attach time.  None when
+        packing cannot help (single class) or is disabled.  The Dataset
+        itself stays canonical — validation sets, tree replay and the
+        binary cache all speak canonical feature order; only a training
+        booster's device copy of ``bins`` is reordered."""
+        from .binning import plan_feature_packing
+        if not len(self.bin_mappers):
+            return None
+        return plan_feature_packing(self.num_bins,
+                                    int(self.num_bins.max()), mode=mode)
+
     def bin_upper_bounds_matrix(self) -> np.ndarray:
         """[F, max_bins] float64, padded with +inf; device-side threshold
         real-value lookup."""

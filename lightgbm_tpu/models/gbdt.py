@@ -14,6 +14,7 @@ rows (in-bag and out-of-bag), so the reference's separate OOB traversal path
 from __future__ import annotations
 
 import functools
+import os
 import time
 from typing import Callable, List, Optional
 
@@ -82,6 +83,15 @@ class GBDT:
         # training-health monitor (ISSUE 2, lightgbm_tpu/health.py) —
         # created in init() when the health= setting resolves on
         self._health_monitor = None
+        # pipelined boosting (ISSUE 6): deferred-readback queues.  _pipe
+        # holds ONE dispatched-but-unconsumed per-iteration entry,
+        # _pipe_chunk one dispatched chunk record; _pipeline_auto is set
+        # by run_training when pipeline="auto" resolves on (direct
+        # train_one_iter/train_chunk callers keep synchronous semantics
+        # unless the config forces "readback")
+        self._pipe = None
+        self._pipe_chunk = None
+        self._pipeline_auto = False
 
     # ------------------------------------------------------------------ init
 
@@ -117,6 +127,36 @@ class GBDT:
         self.num_features = train_data.num_features
         # [F, B] bin→upper-bound table for vectorized threshold conversion
         self._bin_upper_table = train_data.bin_upper_bounds_matrix()
+
+        # mixed-bin feature packing (ISSUE 6): when the dataset mixes
+        # narrow (num_bin <= 64) and wide features, reorder the bin matrix
+        # into contiguous bin-width classes so every histogram pass prices
+        # each class at ITS width instead of the uniform worst case.  The
+        # spec is a static (hashable) layout descriptor threaded through
+        # the growers; all histograms are reassembled into canonical
+        # feature order before split finding, so trees/splits/ownership
+        # are bit-identical to the uniform path.  Feature-parallel keeps
+        # the uniform layout — its ownership slices are arbitrary feature
+        # subsets that a class-contiguous layout cannot serve.
+        mixed_mode = getattr(self.tree_config, "mixed_bin", "auto")
+        self._pack_spec = None
+        if (learner is not None
+                and type(learner).__name__ == "FeatureParallelLearner"):
+            if mixed_mode == "true":
+                log.warning("mixed_bin is not supported by the feature-"
+                            "parallel learner; keeping the uniform layout")
+        else:
+            self._pack_spec = train_data.plan_packing(mode=mixed_mode)
+        if self._pack_spec is not None:
+            telemetry.count_route("hist_layout", "hist/mixedbin_on")
+            log.info("mixed-bin packing: %d narrow (<=%d bins) + %d wide "
+                     "features (histogram passes per class: %s)"
+                     % (self._pack_spec.counts[0],
+                        self._pack_spec.widths[0],
+                        self._pack_spec.counts[1],
+                        "x".join(str(w) for w in self._pack_spec.widths)))
+        else:
+            telemetry.count_route("hist_layout", "hist/mixedbin_off")
 
         # multi-process data parallelism (the reference's N-machine mode,
         # dataset.cpp:172-216): each process holds a row shard; lift every
@@ -167,8 +207,8 @@ class GBDT:
                           "data-parallel training (no row-aligned state "
                           "globalization)")
             self.num_data = max_n * jax.process_count()
-            self.bins_device = self._mp_make_global(train_data.bins,
-                                                    row_axis=1)
+            self.bins_device = self._mp_make_global(
+                self._bins_host(train_data), row_axis=1)
             # replicated small arrays stay host-side (every process passes
             # identical values into the jitted programs)
             self.num_bins_device = np.asarray(train_data.num_bins)
@@ -187,7 +227,7 @@ class GBDT:
             # process passes identical (replicated) values into the
             # global-mesh chunk program
             _arr0 = np.asarray if self._mp_fp else jnp.asarray
-            self.bins_device = _arr0(train_data.bins)
+            self.bins_device = _arr0(self._bins_host(train_data))
             self.num_bins_device = _arr0(train_data.num_bins)
             self._row_valid = None
             init_score = train_data.metadata.init_score
@@ -280,6 +320,15 @@ class GBDT:
         # one-shot dataset-residency report (memory gauges), filed at
         # train start — after add_valid_dataset calls — by _file_residency
         self._residency_filed = False
+
+    def _bins_host(self, train_data) -> np.ndarray:
+        """Host-side bin matrix in the booster's storage layout: canonical
+        feature order, or packed bin-width-class order under mixed-bin
+        (one row gather, paid once at init)."""
+        if self._pack_spec is None:
+            return train_data.bins
+        perm = np.asarray(self._pack_spec.perm, np.int64)
+        return np.ascontiguousarray(train_data.bins[perm])
 
     def _file_residency(self) -> None:
         """File the one-shot dataset-residency report on the first
@@ -419,9 +468,92 @@ class GBDT:
         mask[self._feat_rngs[cls].choice(F, used_cnt, replace=False)] = True
         return mask
 
+    # ------------------------------------------------------ pipelined loop
+
+    def _pipeline_on(self) -> bool:
+        """The ``pipeline=`` resolution rule, single-homed: the env hatch
+        (LGBM_TPU_PIPELINE) beats the config; "auto" is on only inside
+        run_training (``_pipeline_auto``); multi-process runs stay
+        synchronous (replicated host inputs make deferred consumption a
+        cross-host ordering hazard for no measured win)."""
+        env = os.environ.get("LGBM_TPU_PIPELINE", "")
+        mode = env if env in ("off", "readback") else getattr(
+            getattr(self, "gbdt_config", None), "pipeline", "off")
+        if mode == "off":
+            on = False
+        elif mode == "readback":
+            on = True
+        else:
+            on = self._pipeline_auto
+        return on and not self._host_inputs and jax.process_count() == 1
+
+    def _rng_snapshot(self):
+        """Host RNG/mask state needed to rewind a dispatched-but-discarded
+        iteration (pipelined rollback): bagging stream + mask caches and
+        the per-class feature-fraction streams.  None-components skip the
+        copy when the corresponding sampling is off."""
+        bag = self._bag_rng.get_state() if self._use_bagging else None
+        masks = ((self._bag_mask.copy(), self._bag_mask_device)
+                 if self._use_bagging else None)
+        ff = ([r.get_state() for r in self._feat_rngs]
+              if self.tree_config.feature_fraction < 1.0 else None)
+        return (bag, ff, masks)
+
+    def _rng_restore(self, snap) -> None:
+        if snap is None:
+            return
+        bag, ff, masks = snap
+        if bag is not None:
+            self._bag_rng.set_state(bag)
+        if ff is not None:
+            for r, s in zip(self._feat_rngs, ff):
+                r.set_state(s)
+        if masks is not None:
+            self._bag_mask, self._bag_mask_device = masks
+
+    def flush_pipeline(self) -> bool:
+        """Consume every deferred readback (pipelined boosting).  Called
+        by run_training at loop end; direct train_one_iter/train_chunk
+        callers that force pipeline=readback must call it before reading
+        ``models``/scores.  Returns True when the consumed work says
+        training stopped (degenerate tree or early stopping)."""
+        stop = False
+        if self._pipe is not None:
+            entry, self._pipe = self._pipe, None
+            stop = self._consume_iter_entry(entry, newer=None)
+        if self._pipe_chunk is not None:
+            rec, self._pipe_chunk = self._pipe_chunk, None
+            stop = self._consume_chunk(rec, newer_inflight=False) or stop
+        return stop
+
     def train_one_iter(self, is_eval: bool = True) -> bool:
         """GBDT::TrainOneIter (gbdt.cpp:167-214).  Returns True when
-        training must stop (early stopping or no splittable leaf)."""
+        training must stop (early stopping or no splittable leaf).
+
+        Pipelined mode (pipeline=readback): this call DISPATCHES iteration
+        i and consumes iteration i-1's deferred model readback — the
+        device work is dispatched in exactly the synchronous order, only
+        the host wait moves one iteration later, so trees/scores/metrics
+        are exact-identical (stops are discovered one call late and the
+        surplus dispatched iteration is rolled back from snapshots)."""
+        if self._pipeline_on():
+            self._file_residency()
+            if self._pipe_chunk is not None:
+                # mixing chunked and per-iteration paths mid-pipeline:
+                # drain the chunk first (ordering)
+                if self.flush_pipeline():
+                    return True
+            entry = self._dispatch_one_iter(is_eval)
+            prev, self._pipe = self._pipe, entry
+            if prev is not None and self._consume_iter_entry(prev,
+                                                             newer=entry):
+                self._pipe = None
+                return True
+            return False
+        if self._pipe is not None or self._pipe_chunk is not None:
+            # pipeline turned off with work in flight: drain first
+            if self.flush_pipeline():
+                return True
         self._file_residency()
         mon = self._health_monitor
         with telemetry.span("gradient") as sp:
@@ -569,6 +701,195 @@ class GBDT:
                             - self.early_stopping_round * self.num_class:]
         return met_early_stopping
 
+    def _dispatch_one_iter(self, is_eval: bool) -> dict:
+        """Dispatch one boosting iteration's device work (gradients, per-
+        class grow + async model copy + score/valid updates) WITHOUT the
+        model readback — exactly train_one_iter's dispatch sequence.  The
+        returned entry carries everything the deferred consumption needs:
+        the in-flight small-array handles, post-update score/valid
+        references per class (functional updates make these free), and
+        host RNG snapshots for exact rollback when a stop is discovered
+        late."""
+        mon = self._health_monitor
+        pre_rng = self._rng_snapshot()
+        with telemetry.span("gradient") as sp:
+            grad, hess = self.objective.get_gradients(
+                self.score if self.num_class > 1 else self.score[0])
+            sp.fence((grad, hess))
+        if self.num_class == 1:
+            grad = grad[None]
+            hess = hess[None]
+        entry = {"iter_no": self.iter, "is_eval": is_eval, "cls": [],
+                 "grad": grad, "hess": hess, "pre_rng": pre_rng,
+                 "mon": mon}
+        lr = jnp.float32(self.gbdt_config.learning_rate)
+        for cls in range(self.num_class):
+            cls_pre = self._rng_snapshot()
+            self._bagging(self.iter)
+            feature_mask = self._feature_sample(cls)
+            row_mask = self._bag_mask_device
+            key = feature_mask.tobytes()
+            if key not in self._feat_mask_device:
+                self._feat_mask_device.clear()
+                self._feat_mask_device[key] = jnp.asarray(feature_mask)
+            with telemetry.span("grow") as sp:
+                tree_arrays = self._learner(
+                    self, self.bins_device, grad[cls], hess[cls], row_mask,
+                    self._feat_mask_device[key])
+                sp.fence(tree_arrays)
+            small = tree_arrays._replace(leaf_ids=None)
+            try:
+                for arr in jax.tree.leaves(small):
+                    arr.copy_to_host_async()
+            except Exception:
+                pass
+            with telemetry.span("score_update") as sp:
+                shrunk = jnp.where(tree_arrays.num_leaves > 1,
+                                   tree_arrays.leaf_value * lr, 0.0)
+                self.score = self.score.at[cls].add(
+                    _leaf_lookup(shrunk, tree_arrays.leaf_ids))
+                sp.fence(self.score)
+            if self.valid_datasets:
+                max_nodes = len(tree_arrays.split_feature)
+                with telemetry.span("valid_update") as sp:
+                    for v_entry in self.valid_datasets:
+                        new_cls = add_tree_score(
+                            v_entry["bins"], v_entry["score"][cls],
+                            tree_arrays.split_feature,
+                            tree_arrays.threshold_bin,
+                            tree_arrays.left_child,
+                            tree_arrays.right_child,
+                            shrunk,
+                            tree_arrays.num_leaves,
+                            max_nodes=max_nodes)
+                        v_entry["score"] = v_entry["score"].at[cls].set(
+                            new_cls)
+                        sp.fence(new_cls)
+            entry["cls"].append({
+                "small": small,
+                "pre_rng": cls_pre,
+                "score_after": self.score,
+                "valid_after": tuple(e["score"]
+                                     for e in self.valid_datasets),
+            })
+        # dispatch-time increment: the next dispatched iteration's bagging
+        # draws key off self.iter; stops discovered at consumption reset it
+        self.iter += 1
+        return entry
+
+    def _pipe_restore(self, rec, rng_target) -> None:
+        """Rewind booster state to exactly ``rec``'s post-update point
+        (score/valid refs) and the given RNG snapshot (None = already
+        correct)."""
+        self.score = rec["score_after"]
+        for e, s in zip(self.valid_datasets, rec["valid_after"]):
+            e["score"] = s
+        self._rng_restore(rng_target)
+
+    def _consume_iter_entry(self, entry, newer) -> bool:
+        """Deferred consumption of one dispatched iteration: model
+        readback, host tree construction, health/eval/early-stop
+        bookkeeping — the synchronous path's tail, verbatim in order.
+        ``newer`` is the already-dispatched next iteration (rolled back
+        when this one stops) or None on flush."""
+        mon = entry["mon"]
+        C = self.num_class
+        it = entry["iter_no"]
+        for cls, rec in enumerate(entry["cls"]):
+            with telemetry.span("model_readback"):
+                host = jax.device_get(rec["small"])
+            num_leaves = int(host.num_leaves)
+            if mon is not None:
+                mon.add_tree(num_leaves, host.split_gain, host.leaf_count)
+            if num_leaves <= 1:
+                log.info("Can't training anymore, there isn't any leaf "
+                         "meets split requirements.")
+                # synchronous semantics: state ends after THIS class's
+                # (zero) score update, with later classes' and any newer
+                # iteration's dispatched work undone
+                if cls + 1 < C:
+                    rng_target = entry["cls"][cls + 1]["pre_rng"]
+                elif newer is not None:
+                    rng_target = newer["pre_rng"]
+                else:
+                    rng_target = None
+                self._pipe_restore(rec, rng_target)
+                self.iter = it
+                if mon is not None:
+                    hvec = mon.grad_health_async(entry["grad"],
+                                                 entry["hess"], self.score)
+                    block = mon.assemble(hvec)
+                    if telemetry.sink_active():
+                        dp, dt = telemetry.take_phase_deltas()
+                        telemetry.emit_iteration(
+                            it + 1, dp, dt,
+                            eval_metrics=self._last_eval_values,
+                            health=block,
+                            memory=telemetry.take_memory_record(),
+                            extra={"stopped": "degenerate_tree"})
+                    mon.apply_policy(block, it + 1)
+                return True
+            tree = self._to_host_tree(host)
+            tree.shrinkage(self.gbdt_config.learning_rate)
+            self.models.append(tree)
+
+        last = entry["cls"][-1]
+        hvec = (mon.grad_health_async(entry["grad"], entry["hess"],
+                                      last["score_after"])
+                if mon is not None else None)
+        met_early_stopping = False
+        if entry["is_eval"]:
+            with telemetry.span("eval"):
+                met_early_stopping = self._output_metric_at(it + 1, last)
+        health_block = mon.assemble(hvec) if mon is not None else None
+        if telemetry.sink_active():
+            dp, dt = telemetry.take_phase_deltas()
+            telemetry.emit_iteration(it + 1, dp, dt,
+                                     eval_metrics=self._last_eval_values,
+                                     health=health_block,
+                                     memory=telemetry.take_memory_record())
+        if mon is not None:
+            from ..health import TrainingHealthError
+            try:
+                mon.apply_policy(health_block, it + 1)
+            except TrainingHealthError:
+                # halt must leave the booster at exactly iteration it+1:
+                # undo the newer dispatched iteration before re-raising
+                if newer is not None:
+                    self._pipe_restore(last, newer["pre_rng"])
+                self.iter = it + 1
+                self._pipe = None
+                raise
+        if met_early_stopping:
+            log.info("Early stopping at iteration %d, the best iteration "
+                     "round is %d"
+                     % (it + 1, it + 1 - self.early_stopping_round))
+            del self.models[len(self.models)
+                            - self.early_stopping_round * self.num_class:]
+            if newer is not None:
+                self._pipe_restore(last, newer["pre_rng"])
+            self.iter = it + 1
+            return True
+        return False
+
+    def _output_metric_at(self, iteration: int, rec) -> bool:
+        """output_metric over a pipelined entry's own score snapshot: the
+        live ``self.score`` may already carry the NEXT iteration's update,
+        so swap the entry's references in for the evaluation and restore
+        the newest state after (stop paths re-restore from snapshots
+        anyway)."""
+        cur_score = self.score
+        cur_valid = [e["score"] for e in self.valid_datasets]
+        self.score = rec["score_after"]
+        for e, s in zip(self.valid_datasets, rec["valid_after"]):
+            e["score"] = s
+        try:
+            return self.output_metric(iteration)
+        finally:
+            self.score = cur_score
+            for e, s in zip(self.valid_datasets, cur_valid):
+                e["score"] = s
+
     def run_training(self, num_iterations: int, is_eval: bool,
                      save_fn: Optional[Callable] = None,
                      chunk_size: int = 8,
@@ -596,6 +917,15 @@ class GBDT:
         if wd_armed:
             telemetry.watchdog_checkin(phase="run_training",
                                        iteration=self.iter)
+        # pipelined boosting (ISSUE 6): pipeline="auto" resolves ON inside
+        # this driver — run_training owns the loop AND the flush, so the
+        # deferred readbacks can never leak to a caller.  Explicit
+        # "readback"/"off" (or LGBM_TPU_PIPELINE) win either way.
+        # With a save_fn, auto stays OFF: the in-loop checkpoint must see
+        # every finished tree (a deferred readback would persist each
+        # snapshot one iteration/chunk stale — callers who accept that
+        # lag opt in with pipeline=readback explicitly).
+        self._pipeline_auto = save_fn is None
         try:
             if not self.chunkable_for(is_eval) or (num_iterations < chunk_size
                                                    and not self._mp_fp):
@@ -631,6 +961,16 @@ class GBDT:
                     if stop:
                         break
                     done += chunk_size
+            # drain the deferred readbacks (pipelined mode; no-op
+            # otherwise) so callers see fully-consistent models/scores
+            if self._pipe is not None or self._pipe_chunk is not None:
+                self.flush_pipeline()
+                if wd_armed:
+                    telemetry.watchdog_checkin(iteration=self.iter)
+                if save_fn is not None:
+                    save_fn()
+                if progress_fn is not None:
+                    progress_fn(self.iter)
         except BaseException as e:
             # crash-flush (ISSUE 4): an exception escaping training —
             # TrainingHealthError halts included — must not lose the
@@ -640,6 +980,21 @@ class GBDT:
             # able to join the cross-host aggregation, and the peer
             # processes are raising the same (host-replicated) error
             # rather than waiting in an allgather.
+            #
+            # Pipelined mode: a dispatched-but-unconsumed iteration/chunk
+            # may hold a COMPLETED readback whose trees and telemetry
+            # record the synchronous path would already have banked —
+            # consume it best-effort (the crash may be unrelated to the
+            # device) so the crash loses no finished work; if consumption
+            # itself fails, drop the queue and keep the original error.
+            try:
+                if self._pipe is not None or self._pipe_chunk is not None:
+                    self.flush_pipeline()
+            except BaseException:
+                pass
+            finally:
+                self._pipe = None
+                self._pipe_chunk = None
             if telemetry.sink_active():
                 try:
                     extra = {"aborted": type(e).__name__,
@@ -651,6 +1006,7 @@ class GBDT:
                     pass
             raise
         finally:
+            self._pipeline_auto = False
             if wd_armed:
                 telemetry.disarm_watchdog()
         if self._host_inputs:
@@ -789,6 +1145,37 @@ class GBDT:
                 "must have a device formulation (metrics/device.py) when "
                 "evaluation is consumed (see chunk_supported); use "
                 "train_one_iter / run_training")
+        if self._pipe is not None:
+            # per-iteration entries pending (path switch): drain first
+            if self.flush_pipeline():
+                return True
+        if self._pipeline_on():
+            # pipelined: dispatch THIS chunk before consuming the previous
+            # one, so the previous chunk's stacked-tree transfer (async
+            # copy started at its dispatch) overlaps this chunk's device
+            # execution.  A stop discovered in the previous chunk discards
+            # this dispatch wholesale — the rollback rebuilds score/valid/
+            # RNG from snapshots, so nothing of the surplus dispatch
+            # survives (exact synchronous semantics).
+            rec = self._dispatch_chunk(k, limit, is_eval)
+            prev, self._pipe_chunk = self._pipe_chunk, rec
+            if prev is not None and self._consume_chunk(
+                    prev, newer_inflight=True):
+                self._pipe_chunk = None
+                return True
+            return False
+        if self._pipe_chunk is not None:
+            # pipeline turned off with a chunk in flight: drain first
+            if self.flush_pipeline():
+                return True
+        rec = self._dispatch_chunk(k, limit, is_eval)
+        return self._consume_chunk(rec, newer_inflight=False)
+
+    def _dispatch_chunk(self, k: int, limit: int, is_eval: bool) -> dict:
+        """Dispatch one k-iteration chunk program (mask draws, program
+        invocation, post-chunk score/valid installation, async readback
+        start) and return the consumption record: output handles plus the
+        pre-chunk snapshots _consume_chunk's stop paths rebuild from."""
         self._file_residency()
         mon = self._health_monitor
         has_bag = self._use_bagging
@@ -839,6 +1226,7 @@ class GBDT:
                 quant_rounding=self.tree_config.quant_rounding,
                 leafwise_compact=leafwise_compact_on(self.tree_config),
                 num_features=self.num_features,
+                packing=self._pack_spec,
                 has_bag=has_bag, has_ff=has_ff,
                 train_metric_fns=tuple(s[2] for s in train_specs),
                 valid_metric_fns=tuple(tuple(s[2] for s in specs)
@@ -855,6 +1243,12 @@ class GBDT:
                      if has_ff else None)
         score_before = self.score
         valid_before = [e["score"] for e in self.valid_datasets]
+        # self.iter advances at CONSUMPTION; a pending pipelined chunk
+        # means this dispatch's bagging-freq phase must start past its
+        # planned iterations
+        prev_rec = self._pipe_chunk
+        base_iter = self.iter + (prev_rec["planned"]
+                                 if prev_rec is not None else 0)
 
         # multi-process runs keep replicated inputs host-side (every process
         # passes identical values; a committed local jnp array would clash
@@ -868,7 +1262,7 @@ class GBDT:
             rms = np.zeros((k, C, width), dtype=bool)
             for i in range(k):
                 for cls in range(C):
-                    self._draw_bag_mask(self.iter + i)
+                    self._draw_bag_mask(base_iter + i)
                     rms[i, cls, :fill] = self._bag_mask
             row_masks = (self._mp_make_global(rms, row_axis=2)
                          if self._mp else _arr(rms))
@@ -967,6 +1361,47 @@ class GBDT:
                     tuple(e["score"] for e in self.valid_datasets),
                     tuple(tuple(s[1] for s in specs)
                           for specs in valid_specs)))
+        # post-chunk valid scores install NOW (the next dispatch reads
+        # them); stop paths rebuild from valid_before absolutely, so the
+        # early install is semantics-neutral
+        vscores_out = tuple(np.asarray(s) if self._host_inputs else s
+                            for s in vscores_out)
+        for e, s in zip(self.valid_datasets, vscores_out):
+            e["score"] = s
+        # start the stacked-tree/metric/health transfers immediately: the
+        # copies then overlap whatever the device runs next (pipelined
+        # mode: the following chunk)
+        try:
+            for arr in jax.tree.leaves((stacked, mvals, hvals)):
+                arr.copy_to_host_async()
+        except Exception:
+            pass
+        return {
+            "k": k, "limit": limit, "eval_each": eval_each, "mon": mon,
+            "planned": k if limit < 0 else min(k, limit),
+            "stacked": stacked, "mvals": mvals, "hvals": hvals,
+            "vscores_out": vscores_out,
+            "bag_state": bag_state, "ff_states": ff_states,
+            "score_before": score_before, "valid_before": valid_before,
+        }
+
+    def _consume_chunk(self, rec: dict, newer_inflight: bool) -> bool:
+        """Deferred consumption of one dispatched chunk: model readback,
+        host tree construction, per-iteration metric/health/early-stop
+        bookkeeping, surplus rollback — the synchronous tail of
+        train_chunk, verbatim in order.  ``newer_inflight``: a younger
+        chunk was already dispatched, so every stop path must roll back
+        through the snapshots (erasing the younger chunk's installed
+        score/valid/RNG state) even when this chunk kept all k
+        iterations."""
+        k, limit, eval_each, mon = (rec["k"], rec["limit"],
+                                    rec["eval_each"], rec["mon"])
+        stacked, mvals, hvals = rec["stacked"], rec["mvals"], rec["hvals"]
+        vscores_out = rec["vscores_out"]
+        bag_state, ff_states = rec["bag_state"], rec["ff_states"]
+        score_before = rec["score_before"]
+        valid_before = rec["valid_before"]
+        C = self.num_class
         with telemetry.span("model_readback"):
             host = jax.device_get(stacked)
             mvals_host = np.asarray(mvals) if eval_each else None
@@ -1047,14 +1482,10 @@ class GBDT:
                     # (reference semantics: scores keep the popped trees'
                     # contributions, so roll back only the surplus scan
                     # iterations), THEN pop the early-stopping window
-                    if kept < k:
+                    if kept < k or newer_inflight:
                         self._rollback_chunk(kept * C, kept * C, bag_state,
                                              ff_states, score_before,
                                              valid_before)
-                    else:
-                        for e, s in zip(self.valid_datasets, vscores_out):
-                            e["score"] = (np.asarray(s)
-                                          if self._host_inputs else s)
                     del self.models[len(self.models) - esr * C:]
                     self.iter += kept
                     if mon is not None:
@@ -1073,23 +1504,20 @@ class GBDT:
                     # scan already applied the whole chunk's score
                     # updates, so roll the surplus back before raising
                     kept = i + 1
-                    if kept < k:
+                    if kept < k or newer_inflight:
                         self._rollback_chunk(kept * C, kept * C, bag_state,
                                              ff_states, score_before,
                                              valid_before)
-                    else:
-                        for e, s in zip(self.valid_datasets, vscores_out):
-                            e["score"] = (np.asarray(s)
-                                          if self._host_inputs else s)
                     self.iter += kept
+                    self._pipe_chunk = None
                     raise
         if keep_iters < k:
+            # tail truncation: only possible on the LAST chunk of a run
+            # (limit < k), so no newer chunk can be in flight
             self._rollback_chunk(keep_iters * C, keep_iters * C,
                                  bag_state, ff_states, score_before,
                                  valid_before)
-        else:
-            for e, s in zip(self.valid_datasets, vscores_out):
-                e["score"] = (np.asarray(s) if self._host_inputs else s)
+        # else: score/valid already installed at dispatch
         self.iter += keep_iters
         return False
 
@@ -1135,13 +1563,20 @@ class GBDT:
             if kept_trees > 0 else []
         max_nodes = max(_effective_num_leaves(self.tree_config) - 1, 1)
 
-        def replay(score, bins, tree, cls_m):
+        def replay(score, bins, tree, cls_m, feat_map=None):
+            # ``feat_map``: canonical inner feature -> row of ``bins``;
+            # the TRAIN matrix is in packed (mixed-bin) feature order
+            # while tree.split_feature is canonical, valid matrices are
+            # canonical
             pad = lambda a: np.pad(np.asarray(a), (0, max_nodes - len(a)))
+            sf = np.asarray(tree.split_feature)
+            if feat_map is not None and len(sf):
+                sf = feat_map[sf]
             leaf_vals = np.zeros(max_nodes + 1, np.float32)
             leaf_vals[:tree.num_leaves] = tree.leaf_value
             new_cls = add_tree_score(
                 bins, score[cls_m],
-                pad(tree.split_feature),
+                pad(sf),
                 pad(tree.threshold_bin),
                 pad(tree.left_child),
                 pad(tree.right_child),
@@ -1157,9 +1592,13 @@ class GBDT:
 
         score = score_before
         vscores = list(valid_before)
+        train_fmap = (np.asarray(self._pack_spec.c2p, np.int32)
+                      if getattr(self, "_pack_spec", None) is not None
+                      else None)
         for m, tree in enumerate(kept):
             cls_m = m % C
-            score = replay(score, self.bins_device, tree, cls_m)
+            score = replay(score, self.bins_device, tree, cls_m,
+                           feat_map=train_fmap)
             for v, entry in enumerate(self.valid_datasets):
                 vscores[v] = replay(vscores[v], entry["bins"], tree, cls_m)
         self.score = score
@@ -1614,6 +2053,7 @@ def _get_chunk_program(obj_key, grad_fn, num_class: int, lr: float,
                        quant_rounding: str = "nearest",
                        leafwise_compact: bool = False,
                        num_features: int = 0,
+                       packing=None,
                        has_bag: bool, has_ff: bool,
                        train_metric_fns: tuple = (),
                        valid_metric_fns: tuple = (),
@@ -1630,6 +2070,7 @@ def _get_chunk_program(obj_key, grad_fn, num_class: int, lr: float,
            num_bins_max, min_data_in_leaf, min_sum_hessian_in_leaf,
            max_depth, hist_chunk, hist_dtype, quant_rounding,
            leafwise_compact, use_pp, use_pp and partition_overlap_on(),
+           packing,
            jax.default_backend(), has_bag, has_ff,
            tuple(id(f) for f in train_metric_fns),
            tuple(tuple(id(f) for f in fns) for fns in valid_metric_fns),
@@ -1642,6 +2083,7 @@ def _get_chunk_program(obj_key, grad_fn, num_class: int, lr: float,
         num_leaves=num_leaves, num_bins_max=num_bins_max,
         min_data_in_leaf=min_data_in_leaf,
         min_sum_hessian_in_leaf=min_sum_hessian_in_leaf, max_depth=max_depth,
+        packing=packing,
         **_tuning_kwargs(hist_chunk, hist_dtype, quant_rounding))
     if grow_policy == "depthwise":
         from .grower_depthwise import grow_tree_depthwise as grow
@@ -1722,6 +2164,7 @@ def _serial_learner(gbdt: GBDT, bins, grad, hess, row_mask, feature_mask):
         min_data_in_leaf=gbdt.tree_config.min_data_in_leaf,
         min_sum_hessian_in_leaf=gbdt.tree_config.min_sum_hessian_in_leaf,
         max_depth=gbdt.tree_config.max_depth,
+        packing=gbdt._pack_spec,
         **_tuning_kwargs(gbdt.tree_config.hist_chunk,
                          gbdt.tree_config.hist_dtype,
                          gbdt.tree_config.quant_rounding))

@@ -72,7 +72,7 @@ def _grow_tree_fn(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                   min_data_in_leaf: int, min_sum_hessian_in_leaf: float,
                   max_depth: int = -1, hist_backend: str = "matmul",
                   hist_chunk: int = 16384,
-                  compute_dtype=jnp.float32) -> TreeArrays:
+                  compute_dtype=jnp.float32, packing=None) -> TreeArrays:
     """Grow one tree on a single device (TreeLearner::Train,
     serial_tree_learner.cpp:119-153).  See ``grow_tree_impl`` for the
     customization seam used by the parallel learners.
@@ -83,7 +83,8 @@ def _grow_tree_fn(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         min_data_in_leaf=min_data_in_leaf,
         min_sum_hessian_in_leaf=min_sum_hessian_in_leaf,
         max_depth=max_depth, hist_backend=hist_backend,
-        hist_chunk=hist_chunk, compute_dtype=compute_dtype)
+        hist_chunk=hist_chunk, compute_dtype=compute_dtype,
+        packing=packing)
 
 
 # module-level jit shared across boosters, wrapped in the cost registry
@@ -97,7 +98,7 @@ grow_tree = _costmodel.instrument(
             static_argnames=("num_leaves", "num_bins_max",
                              "min_data_in_leaf", "min_sum_hessian_in_leaf",
                              "max_depth", "hist_backend", "hist_chunk",
-                             "compute_dtype")),
+                             "compute_dtype", "packing")),
     phase="grow")
 
 
@@ -107,6 +108,7 @@ def grow_tree_impl(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                    min_data_in_leaf: int, min_sum_hessian_in_leaf: float,
                    max_depth: int = -1, hist_backend: str = "matmul",
                    hist_chunk: int = 16384, compute_dtype=jnp.float32,
+                   packing=None,
                    hist_reduce=None, hist_axis=None, int_hist_reduce=None,
                    split_finder=None, partition_bins=None,
                    stat_reduce=None, own_slice=None, root_hist_reduce=None,
@@ -125,6 +127,12 @@ def grow_tree_impl(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         (serial_tree_learner.cpp:159-167), possibly ∧ per-shard feature
         ownership for the feature-parallel learner
     num_bins : [F] i32 real bin counts
+    packing : optional io/binning.PackSpec (STATIC) — mixed-bin layout:
+        ``bins`` is stored in packed bin-width-class feature order; the
+        histogram routes run one pass per class and hand back
+        CANONICAL-order histograms, so num_bins/feature_mask/split
+        results stay canonical.  Only partition-time feature indexing
+        translates through the spec's canonical->packed map.
     hist_reduce : optional callable hist→hist; the data-parallel learner
         passes ``lambda h: psum(h, 'data')`` (the ReduceScatter+Allgather
         contract of data_parallel_tree_learner.cpp:135-165).  Under the
@@ -183,7 +191,8 @@ def grow_tree_impl(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                                backend=hist_backend, chunk=hist_chunk,
                                compute_dtype=compute_dtype,
                                axis_name=hist_axis,
-                               int_reduce=int_hist_reduce, salt=salt)
+                               int_reduce=int_hist_reduce, salt=salt,
+                               packing=packing)
         # the quantized path reduces its INT accumulators internally over
         # hist_axis (bit-exactness; ops/hist_pallas.quantize_values) —
         # psum by default, the ownership feature-block scatter when
@@ -216,7 +225,7 @@ def grow_tree_impl(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             full = build_histogram(bins, grad, hess, row_mask, B,
                                    backend=hist_backend, chunk=hist_chunk,
                                    compute_dtype=compute_dtype,
-                                   axis_name=hist_axis)
+                                   axis_name=hist_axis, packing=packing)
             if root_hist_reduce is not None and not (
                     str(compute_dtype).startswith("int8")
                     and hist_axis is not None):
@@ -319,9 +328,14 @@ def grow_tree_impl(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                            .at[node].set(~new_leaf))
 
             # --- partition rows (DataPartition::Split as masked where,
-            # data_partition.hpp:93-139)
+            # data_partition.hpp:93-139).  Under mixed-bin packing the
+            # matrix rows are in packed order while ``feat`` is canonical:
+            # translate through the (trace-time constant) c2p map
+            pfeat = feat
+            if packing is not None and len(packing.widths) > 1:
+                pfeat = jnp.asarray(packing.c2p, jnp.int32)[feat]
             fbin = jax.lax.dynamic_index_in_dim(
-                partition_bins, feat, axis=0, keepdims=False).astype(jnp.int32)
+                partition_bins, pfeat, axis=0, keepdims=False).astype(jnp.int32)
             go_right = fbin > thr
             leaf_ids = jnp.where((tree.leaf_ids == bl) & go_right,
                                  new_leaf, tree.leaf_ids)
@@ -413,7 +427,7 @@ def grow_tree_impl(bins: jax.Array, grad: jax.Array, hess: jax.Array,
 
 _GROW_STATICS = ("num_leaves", "num_bins_max", "min_data_in_leaf",
                  "min_sum_hessian_in_leaf", "max_depth", "hist_backend",
-                 "hist_chunk", "compute_dtype")
+                 "hist_chunk", "compute_dtype", "packing")
 
 
 @functools.partial(jax.jit, static_argnames=_GROW_STATICS)

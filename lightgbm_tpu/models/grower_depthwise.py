@@ -67,7 +67,7 @@ def grow_tree_depthwise(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                         hist_chunk: int = 65536, hist_reduce=None,
                         stat_reduce=None, split_finder=None,
                         partition_bins=None, hist_axis=None,
-                        compute_dtype=jnp.float32,
+                        compute_dtype=jnp.float32, packing=None,
                         hist_reduce_level=None, int_reduce_level=None,
                         own_slice=None) -> TreeArrays:
     """Grow one depth-wise tree.  Output contract == grow_tree_impl's
@@ -136,7 +136,10 @@ def grow_tree_depthwise(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         out = histogram_leafbatch(b, g, h, col_id, col_ok, C, B,
                                   chunk=hist_chunk,
                                   compute_dtype=compute_dtype,
-                                  axis_name=hist_axis, **extra)
+                                  axis_name=hist_axis,
+                                  **({"packing": packing}
+                                     if packing is not None else {}),
+                                  **extra)
         # the quantized path reduces its INT accumulators internally over
         # hist_axis (bit-exactness); applying hist_reduce again would
         # double-count
@@ -273,7 +276,13 @@ def grow_tree_depthwise(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         # table.
         small_is_right = res.right_count < res.left_count        # ties → left
         with telemetry.span("partition") as _sp:
-            table = jnp.stack([res.feature.astype(f32),
+            # mixed-bin packing stores the matrix rows in packed order;
+            # the per-slot partition feature must address that layout
+            # (the recorded split_feature above stays canonical)
+            feat_part = res.feature
+            if packing is not None and len(packing.widths) > 1:
+                feat_part = jnp.asarray(packing.c2p, jnp.int32)[res.feature]
+            table = jnp.stack([feat_part.astype(f32),
                                res.threshold.astype(f32),
                                chosen.astype(f32),
                                right_leaf.astype(f32),
@@ -392,5 +401,5 @@ grow_tree_depthwise_jit = _costmodel.instrument(
             static_argnames=("num_leaves", "num_bins_max",
                              "min_data_in_leaf", "min_sum_hessian_in_leaf",
                              "max_depth", "hist_chunk", "compute_dtype",
-                             "hist_axis")),
+                             "packing", "hist_axis")),
     phase="grow")

@@ -105,6 +105,7 @@ def _grow_tree_leafcompact_fn(bins, grad, hess, row_mask, feature_mask,
                               hist_backend: str = "matmul",
                               hist_chunk: int = 16384,
                               compute_dtype=jnp.float32,
+                              packing=None,
                               use_pallas_partition: bool = False,
                               partition_overlap: bool = True,
                               interpret: bool = False) -> TreeArrays:
@@ -115,6 +116,7 @@ def _grow_tree_leafcompact_fn(bins, grad, hess, row_mask, feature_mask,
         min_sum_hessian_in_leaf=min_sum_hessian_in_leaf,
         max_depth=max_depth, hist_backend=hist_backend,
         hist_chunk=hist_chunk, compute_dtype=compute_dtype,
+        packing=packing,
         use_pallas_partition=use_pallas_partition,
         partition_overlap=partition_overlap, interpret=interpret)
 
@@ -130,7 +132,8 @@ grow_tree_leafcompact = _costmodel.instrument(
             static_argnames=("num_leaves", "num_bins_max",
                              "min_data_in_leaf", "min_sum_hessian_in_leaf",
                              "max_depth", "hist_backend", "hist_chunk",
-                             "compute_dtype", "use_pallas_partition",
+                             "compute_dtype", "packing",
+                             "use_pallas_partition",
                              "partition_overlap", "interpret")),
     phase="grow")
 
@@ -143,6 +146,7 @@ def grow_tree_leafcompact_impl(bins, grad, hess, row_mask, feature_mask,
                                hist_backend: str = "matmul",
                                hist_chunk: int = 16384,
                                compute_dtype=jnp.float32,
+                               packing=None,
                                use_pallas_partition: bool = False,
                                partition_overlap: bool = True,
                                interpret: bool = False,
@@ -198,6 +202,8 @@ def grow_tree_leafcompact_impl(bins, grad, hess, row_mask, feature_mask,
     root_hist_reduce = _tl.collective_span(
         "leafcompact/root_hist", root_hist_reduce, kind="reduce",
         axis=hist_axis, phase="grow")
+    c2p_arr = (jnp.asarray(packing.c2p, jnp.int32)
+               if packing is not None and len(packing.widths) > 1 else None)
     table = bucket_table(N, min_width=max(BLOCK, (-(-N // BLOCK) * BLOCK)
                                           >> 9))
     P = table[0]
@@ -213,7 +219,8 @@ def grow_tree_leafcompact_impl(bins, grad, hess, row_mask, feature_mask,
                                backend=hist_backend, chunk=hist_chunk,
                                compute_dtype=compute_dtype,
                                axis_name=hist_axis,
-                               int_reduce=int_hist_reduce, salt=salt)
+                               int_reduce=int_hist_reduce, salt=salt,
+                               packing=packing)
         # the quantized path reduces its INT accumulators internally over
         # hist_axis (grower.grow_tree_impl's rule, kept identical) — psum
         # by default, the ownership feature-block scatter when
@@ -265,7 +272,7 @@ def grow_tree_leafcompact_impl(bins, grad, hess, row_mask, feature_mask,
         full = build_histogram(bins, grad, hess, row_mask, B,
                                backend=hist_backend, chunk=hist_chunk,
                                compute_dtype=compute_dtype,
-                               axis_name=hist_axis)
+                               axis_name=hist_axis, packing=packing)
         if root_hist_reduce is not None and not (
                 str(compute_dtype).startswith("int8")
                 and hist_axis is not None):
@@ -338,8 +345,9 @@ def grow_tree_leafcompact_impl(bins, grad, hess, row_mask, feature_mask,
             cs = jnp.minimum(start, P - W)        # clamp: slice stays
             delta = start - cs                    # in-pane; mask realigns
             seg = jax.lax.dynamic_slice(pane, (jnp.int32(0), cs), (R, W))
+            pfeat = feat if c2p_arr is None else c2p_arr[feat]
             fbin = jax.lax.dynamic_index_in_dim(
-                seg[:F], feat, axis=0, keepdims=False).astype(jnp.int32)
+                seg[:F], pfeat, axis=0, keepdims=False).astype(jnp.int32)
             fbin = fbin & 255                     # int8 pane -> uint8 bin
             lane = jnp.arange(W, dtype=jnp.int32)
             inseg = (lane >= delta) & (lane < delta + cnt)
@@ -405,8 +413,9 @@ def grow_tree_leafcompact_impl(bins, grad, hess, row_mask, feature_mask,
 
             # --- original-order leaf ids (score updates need them; the
             # pane's permutation never leaves this function)
+            ofeat = feat if c2p_arr is None else c2p_arr[feat]
             obin = jax.lax.dynamic_index_in_dim(
-                bins, feat, axis=0, keepdims=False).astype(jnp.int32)
+                bins, ofeat, axis=0, keepdims=False).astype(jnp.int32)
             leaf_ids = jnp.where((tree.leaf_ids == bl) & (obin > thr),
                                  new_leaf, tree.leaf_ids)
 

@@ -24,7 +24,9 @@ class BinaryLogloss:
         label = np.asarray(metadata.label)
         cnt_positive = int((label == 1).sum())
         cnt_negative = num_data - cnt_positive
-        log.info("Number of postive:%d,  number of negative:%d"
+        # (the reference's own log line misspells "postive",
+        # binary_objective.hpp — fixed here, not parity-relevant)
+        log.info("Number of positive:%d,  number of negative:%d"
                  % (cnt_positive, cnt_negative))
         if cnt_positive == 0 or cnt_negative == 0:
             log.fatal("Input training data only contains one class")

@@ -323,18 +323,44 @@ def _grouped(fn, bins, grad, hess, col_id, col_ok, num_cols, B, *,
     return jnp.concatenate(parts, axis=0)
 
 
+def _class_acc_assemble(parts, packing, B: int):
+    """Per-class accumulators (packed feature order, feature axis 0, bin
+    axis 1) -> ONE canonical-order accumulator padded to B bins.  Stays in
+    the accumulator's own domain (int32 for the quantized kernels), so the
+    ownership psum_scatter / cross-shard psum that follows operates on
+    canonical contiguous feature blocks exactly as in the uniform path —
+    the per-class passes ride the EXISTING reduction schedule unchanged.
+    ONE implementation (ops/histogram._assemble_classes): the reassembly
+    is the bit-identity-critical step, so every kernel route must share
+    it."""
+    from .histogram import _assemble_classes
+    return _assemble_classes(parts, packing, B, feat_axis=0, bin_axis=1)
+
+
+def _packing_on(packing) -> bool:
+    from .histogram import _packing_active
+    return _packing_active(packing)
+
+
 def hist_pallas_leafbatch(bins, grad, hess, col_id, col_ok, num_cols: int,
                           num_bins_max: int, *, chunk: int = 2048,
                           dtype: str = "int8", rng_bits=None,
                           axis_name=None, int_reduce=None,
-                          stochastic=False, salt=0):
+                          stochastic=False, salt=0, packing=None):
     """Drop-in histogram_leafbatch equivalent on the Pallas kernel.
 
     ``bins`` is the usual [F, N] matrix (int8 or uint8).  The int32
     accumulator dequantizes to the usual [C, F, B, 3] f32.  Levels up to
     64 columns run as ONE pass (<=42 columns fill one 128-lane MXU tile;
     43-64 use a 192-lane operand = 1.5 tiles, cheaper than two full
-    passes over the data); wider levels split into 64-column groups."""
+    passes over the data); wider levels split into 64-column groups.
+
+    ``packing`` (mixed-bin layout): one kernel launch per bin-width class
+    — the narrow class's [Fc, 64, lanes] accumulator costs a quarter of
+    the 255-wide pass in MXU/one-hot work — assembled back into ONE
+    canonical int accumulator BEFORE the cross-shard reduction, so the
+    int-domain bit-exactness chain and the DP ownership schedule are
+    untouched."""
     from .. import telemetry
     # named_scope unconditionally (the span is a no-op with telemetry
     # off): profile_dir= traces label the kernel "histogram" either way
@@ -343,14 +369,19 @@ def hist_pallas_leafbatch(bins, grad, hess, col_id, col_ok, num_cols: int,
             _hist_pallas_one, bins, grad, hess, col_id, col_ok,
             num_cols, num_bins_max, group_width=64, chunk=chunk,
             dtype=dtype, rng_bits=rng_bits, axis_name=axis_name,
-            int_reduce=int_reduce, stochastic=stochastic, salt=salt))
+            int_reduce=int_reduce, stochastic=stochastic, salt=salt,
+            packing=packing))
 
 
 def _hist_pallas_one(bins, grad, hess, col_id, col_ok, num_cols, B, *,
                      chunk, dtype, rng_bits, axis_name=None,
-                     int_reduce=None, stochastic=False, salt=0):
+                     int_reduce=None, stochastic=False, salt=0,
+                     packing=None):
     F, N = bins.shape
     lanes = LANES if num_cols <= 42 else 192
+    # ONE quantization for every class pass: the scale comes from the same
+    # grad/hess/col_ok whatever the feature layout, so packed and uniform
+    # passes quantize identically (bit-identity precondition)
     vals, scale = quantize_values(grad, hess, col_ok, rng_bits,
                                   axis_name=axis_name,
                                   stochastic=stochastic, salt=salt)
@@ -361,9 +392,19 @@ def _hist_pallas_one(bins, grad, hess, col_id, col_ok, num_cols, B, *,
     if pad:
         bins = jnp.pad(bins, ((0, 0), (0, pad)))
         packed = jnp.pad(packed, ((0, 0), (0, pad)), constant_values=-1)
-    acc = hist_pallas_raw(bins.astype(jnp.int8), packed, B=B,
-                          chunk=chunk, dtype=dtype,
-                          lanes=lanes)                       # [F, B, lanes]
+    if _packing_on(packing):
+        from .. import telemetry
+        telemetry.count("hist/mixedbin_pallas_int")
+        parts = [hist_pallas_raw(
+            jax.lax.slice_in_dim(bins, start, start + cnt,
+                                 axis=0).astype(jnp.int8),
+            packed, B=width, chunk=chunk, dtype=dtype, lanes=lanes)
+            for start, cnt, width in packing.ranges]
+        acc = _class_acc_assemble(parts, packing, B)         # [F, B, lanes]
+    else:
+        acc = hist_pallas_raw(bins.astype(jnp.int8), packed, B=B,
+                              chunk=chunk, dtype=dtype,
+                              lanes=lanes)                   # [F, B, lanes]
     if int_reduce is not None:
         # ownership schedule: psum_scatter the INT accumulators by feature
         # block (feature axis 0) — still int-domain, still bit-exact
@@ -385,7 +426,7 @@ def _hist_pallas_one(bins, grad, hess, col_id, col_ok, num_cols, B, *,
 def hist_pallas_float_leafbatch(bins, grad, hess, col_id, col_ok,
                                 num_cols: int, num_bins_max: int, *,
                                 chunk: int = 2048,
-                                precision: str = "bf16"):
+                                precision: str = "bf16", packing=None):
     """Float-gradient Pallas histogram — [C, F, B, 3] f32, same contract as
     histogram_leafbatch's einsum formulation but hand-scheduled (and so
     immune to the environment's XLA einsum-lowering regression, BASELINE.md
@@ -417,14 +458,33 @@ def hist_pallas_float_leafbatch(bins, grad, hess, col_id, col_ok,
         if precision == "f32x1":
             return _grouped(_hist_float_one, bins, grad, hess, col_id,
                             col_ok, num_cols, num_bins_max, group_width=38,
-                            chunk=chunk, precision=precision)
+                            chunk=chunk, precision=precision,
+                            packing=packing)
         return _grouped(_hist_float_one, bins, grad, hess, col_id, col_ok,
                         num_cols, num_bins_max, group_width=64, chunk=chunk,
-                        precision=precision)
+                        precision=precision, packing=packing)
 
 
 def _hist_float_one(bins, grad, hess, col_id, col_ok, num_cols, B, *,
-                    chunk, precision):
+                    chunk, precision, packing=None):
+    if _packing_on(packing):
+        # one kernel launch per bin-width class over the class's feature
+        # rows; f32 accumulation is per row-chunk in fixed grid order, so
+        # every canonical cell sums in exactly the uniform pass's order
+        from .. import telemetry
+        telemetry.count("hist/mixedbin_pallas_float")
+        parts = []
+        for start, cnt, width in packing.ranges:
+            h = _hist_float_one(
+                jax.lax.slice_in_dim(bins, start, start + cnt, axis=0),
+                grad, hess, col_id, col_ok, num_cols, width,
+                chunk=chunk, precision=precision)        # [C, Fc, w, 3]
+            if width < B:
+                h = jnp.pad(h, ((0, 0), (0, 0), (0, B - width), (0, 0)))
+            parts.append(h)
+        packed_h = jnp.concatenate(parts, axis=1)
+        return jnp.take(packed_h, jnp.asarray(packing.c2p, jnp.int32),
+                        axis=1)
     F, N = bins.shape
     okf = col_ok.astype(jnp.float32)
     g = grad.astype(jnp.float32) * okf
@@ -473,10 +533,13 @@ def _hist_float_one(bins, grad, hess, col_id, col_ok, num_cols, B, *,
 def hist_quant_xla(bins, grad, hess, col_id, col_ok, num_cols: int,
                    num_bins_max: int, *, chunk: int = 65536, rng_bits=None,
                    axis_name=None, int_reduce=None,
-                   stochastic=False, salt=0):
+                   stochastic=False, salt=0, packing=None):
     """XLA reference of the SAME quantized-gradient math as the Pallas int8
     kernel (bit-identical output) — the CPU-testable oracle and the
-    fallback on non-TPU backends."""
+    fallback on non-TPU backends.  ``packing``: per-class int accumulators
+    assembled canonically before the cross-shard reduction, exactly like
+    the Pallas route (int32 sums are order-free, so packed == uniform is
+    bit-exact here by construction)."""
     from .. import telemetry
     telemetry.count("hist/xla_int_kernel")
     with jax.named_scope("histogram"), telemetry.span("histogram") as sp:
@@ -484,27 +547,16 @@ def hist_quant_xla(bins, grad, hess, col_id, col_ok, num_cols: int,
             _hist_quant_xla_one, bins, grad, hess, col_id, col_ok,
             num_cols, num_bins_max, chunk=chunk, rng_bits=rng_bits,
             axis_name=axis_name, int_reduce=int_reduce,
-            stochastic=stochastic, salt=salt))
+            stochastic=stochastic, salt=salt, packing=packing))
 
 
-def _hist_quant_xla_one(bins, grad, hess, col_id, col_ok, num_cols, B, *,
-                        chunk, rng_bits, axis_name=None, int_reduce=None,
-                        stochastic=False, salt=0):
-    F, N = bins.shape
-    C = num_cols
-    # don't pad a small input up to a full default chunk
-    chunk = min(chunk, max(256, -(-N // 256) * 256))
-    vals, scale = quantize_values(grad, hess, col_ok, rng_bits,
-                                  axis_name=axis_name,
-                                  stochastic=stochastic, salt=salt)
-    cid = jnp.where(col_ok, col_id, -1).astype(jnp.int32)
-    pad = (-N) % chunk
-    if pad:
-        bins = jnp.pad(bins, ((0, 0), (0, pad)))
-        vals = jnp.pad(vals, ((0, 0), (0, pad)))
-        cid = jnp.pad(cid, (0, pad), constant_values=-1)
-    n_chunks = (N + pad) // chunk
-    bins_c = bins.astype(jnp.int32).reshape(F, n_chunks, chunk).transpose(1, 0, 2)
+def _quant_xla_acc(bins, vals, cid, B: int, C: int, chunk: int):
+    """One class's raw [F, B, C*3] int32 accumulator (rows pre-padded)."""
+    F = bins.shape[0]
+    N = bins.shape[1]
+    n_chunks = N // chunk
+    bins_c = bins.astype(jnp.int32).reshape(F, n_chunks,
+                                            chunk).transpose(1, 0, 2)
     vals_c = vals.astype(jnp.int32).T.reshape(n_chunks, chunk, 3)
     cid_c = cid.reshape(n_chunks, chunk)
     ib = jnp.arange(B, dtype=jnp.int32)
@@ -521,6 +573,35 @@ def _hist_quant_xla_one(bins, grad, hess, col_id, col_ok, num_cols, B, *,
 
     init = jnp.zeros((F, B, C * 3), jnp.int32)
     hist, _ = jax.lax.scan(body, init, (bins_c, vals_c, cid_c))
+    return hist
+
+
+def _hist_quant_xla_one(bins, grad, hess, col_id, col_ok, num_cols, B, *,
+                        chunk, rng_bits, axis_name=None, int_reduce=None,
+                        stochastic=False, salt=0, packing=None):
+    F, N = bins.shape
+    C = num_cols
+    # don't pad a small input up to a full default chunk
+    chunk = min(chunk, max(256, -(-N // 256) * 256))
+    vals, scale = quantize_values(grad, hess, col_ok, rng_bits,
+                                  axis_name=axis_name,
+                                  stochastic=stochastic, salt=salt)
+    cid = jnp.where(col_ok, col_id, -1).astype(jnp.int32)
+    pad = (-N) % chunk
+    if pad:
+        bins = jnp.pad(bins, ((0, 0), (0, pad)))
+        vals = jnp.pad(vals, ((0, 0), (0, pad)))
+        cid = jnp.pad(cid, (0, pad), constant_values=-1)
+    if _packing_on(packing):
+        from .. import telemetry
+        telemetry.count("hist/mixedbin_xla_int")
+        parts = [_quant_xla_acc(
+            jax.lax.slice_in_dim(bins, start, start + cnt, axis=0),
+            vals, cid, width, C, chunk)
+            for start, cnt, width in packing.ranges]
+        hist = _class_acc_assemble(parts, packing, B)    # [F, B, C*3] i32
+    else:
+        hist = _quant_xla_acc(bins, vals, cid, B, C, chunk)
     if int_reduce is not None:
         hist = int_reduce(hist)                # int-domain feature scatter
         F = hist.shape[0]

@@ -57,6 +57,56 @@ def _pallas_hist_ok(num_bins_max: int) -> bool:
     return ok
 
 
+# ---------------------------------------------------------------------------
+# Mixed-bin packing helpers (ISSUE 6).  A PackSpec (io/binning.py) says the
+# [F, N] bin matrix is stored with features REORDERED into contiguous
+# bin-width classes; every histogram route below then runs one pass per
+# class at that class's width and reassembles the canonical feature order
+# before anything downstream (split finding, subtraction caches, ownership
+# scatters) sees the result.  Reassembly is zero-pad on the bin axis (a
+# narrow feature's bins beyond its own num_bin are zero in the uniform
+# pass too) + one gather on the feature axis — value-identical to the
+# uniform single-pass histogram, cell for cell.
+
+
+def _packing_active(packing) -> bool:
+    return packing is not None and len(packing.widths) > 1
+
+
+def _assemble_classes(parts, packing, B: int, feat_axis: int, bin_axis: int):
+    """Concatenate per-class histograms (packed feature order) and gather
+    back to canonical feature order.  ``parts[i]`` carries the class's
+    features on ``feat_axis`` and ``widths[i]`` bins on ``bin_axis``."""
+    padded = []
+    for part, (_, _, width) in zip(parts, packing.ranges):
+        if width < B:
+            widths = [(0, 0)] * part.ndim
+            widths[bin_axis] = (0, B - width)
+            part = jnp.pad(part, widths)
+        padded.append(part)
+    packed = jnp.concatenate(padded, axis=feat_axis)
+    c2p = jnp.asarray(packing.c2p, jnp.int32)
+    return jnp.take(packed, c2p, axis=feat_axis)
+
+
+def _unpack_bins(bins, packing):
+    """[F, N] packed bin matrix -> canonical feature order (oracle paths:
+    one F-row gather buys exact uniform-path semantics for free)."""
+    return jnp.take(bins, jnp.asarray(packing.c2p, jnp.int32), axis=0)
+
+
+def _einsum_chunk(chunk: int, F: int, B: int, itemsize: int, N: int) -> int:
+    """The leaf-batched einsum's effective row-chunk resolution rule,
+    factored out so the packed driver can pin every per-class pass to the
+    UNIFORM pass's chunk boundaries: f32 per-cell sums accumulate across
+    scan chunks, so identical chunking is what makes packed == uniform
+    bit-identical on the XLA routes (a per-class budget would allow larger
+    chunks — smaller F*B — and regroup the adds)."""
+    budget_rows = max(LEAFBATCH_VIRTUAL_BUDGET // (F * B * itemsize), 256)
+    chunk = min(chunk, -(-budget_rows // 256) * 256)
+    return min(chunk, max(256, -(-N // 256) * 256))
+
+
 def dense_pass_cost(N: int, F: int, B: int, num_cols: int):
     """Analytic cost of ONE leaf-batched histogram pass — the dense
     one-hot-matmul MAC count PROFILE.md's roofline derives by hand
@@ -84,12 +134,26 @@ def dense_pass_cost(N: int, F: int, B: int, num_cols: int):
 
 
 def _note_hist_pass(bins, num_cols: int, num_bins_max: int,
-                    compute_dtype) -> None:
+                    compute_dtype, packing=None) -> None:
+    """Analytic roofline note(s) for one leaf-batched pass.  Under mixed-bin
+    packing the pass is really one pass PER bin-width class, so one note is
+    filed per class (keyed ``binclass<width>``) — PROFILE.md's roofline rows
+    then attribute narrow- and wide-class cost separately instead of
+    pricing every feature at the uniform worst case."""
     if not costmodel.enabled():
         return
     F, N = bins.shape
-    macs, bytes_moved = dense_pass_cost(N, F, num_bins_max, num_cols)
     dt = getattr(compute_dtype, "__name__", None) or str(compute_dtype)
+    if _packing_active(packing):
+        for _, cnt, width in packing.ranges:
+            macs, bytes_moved = dense_pass_cost(N, cnt, width, num_cols)
+            costmodel.note_traced_pass(
+                "histogram",
+                ("pass", N, cnt, width, num_cols, dt,
+                 "binclass%d" % width),
+                macs=macs, bytes_moved=bytes_moved)
+        return
+    macs, bytes_moved = dense_pass_cost(N, F, num_bins_max, num_cols)
     costmodel.note_traced_pass(
         "histogram", ("pass", N, F, num_bins_max, num_cols, dt),
         macs=macs, bytes_moved=bytes_moved)
@@ -98,7 +162,7 @@ def _note_hist_pass(bins, num_cols: int, num_bins_max: int,
 def histogram_matmul(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                      mask: jax.Array, num_bins_max: int,
                      chunk: int = 16384,
-                     compute_dtype=jnp.float32) -> jax.Array:
+                     compute_dtype=jnp.float32, packing=None) -> jax.Array:
     """Build per-feature histograms for the masked row subset.
 
     Parameters
@@ -115,6 +179,22 @@ def histogram_matmul(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     """
     telemetry.count("hist/xla_matmul")
     with telemetry.span("histogram") as sp:
+        if _packing_active(packing):
+            # one pass per bin-width class; the per-class chunk is pinned
+            # to the UNIFORM pass's resolved chunk so the scan's per-cell
+            # f32 accumulation groups identically (bit-identity)
+            F = bins.shape[0]
+            budget_rows = max(
+                CHUNK_BYTE_BUDGET // (F * num_bins_max * 4), 256)
+            eff_chunk = min(chunk, -(-budget_rows // 256) * 256)
+            telemetry.count("hist/mixedbin_matmul")
+            parts = []
+            for start, cnt, width in packing.ranges:
+                parts.append(_histogram_matmul_impl(
+                    jax.lax.slice_in_dim(bins, start, start + cnt, axis=0),
+                    grad, hess, mask, width, eff_chunk, compute_dtype))
+            return sp.fence(_assemble_classes(
+                parts, packing, num_bins_max, feat_axis=0, bin_axis=1))
         return sp.fence(_histogram_matmul_impl(
             bins, grad, hess, mask, num_bins_max, chunk, compute_dtype))
 
@@ -191,7 +271,7 @@ def histogram_leafbatch(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                         num_bins_max: int, chunk: int = 65536,
                         compute_dtype=jnp.bfloat16,
                         axis_name=None, int_reduce=None,
-                        salt=0) -> jax.Array:
+                        salt=0, packing=None) -> jax.Array:
     """Build histograms for MANY leaves in ONE matmul pass.
 
     The single-leaf one-hot matmul starves the MXU: the value operand has
@@ -212,8 +292,16 @@ def histogram_leafbatch(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     Returns
     -------
     hist : [C, F, B, 3] f32
+
+    ``packing`` (io/binning.PackSpec, static): mixed-bin layout — ``bins``
+    is stored in packed (bin-width-class) feature order; every route below
+    runs one pass per class at that class's width and returns the
+    CANONICAL-order histogram, value-identical to the uniform pass.
     """
-    _note_hist_pass(bins, num_cols, num_bins_max, compute_dtype)
+    if _packing_active(packing):
+        telemetry.count("hist/mixedbin_leafbatch")
+    _note_hist_pass(bins, num_cols, num_bins_max, compute_dtype,
+                    packing=packing)
     if str(compute_dtype).startswith("int8"):
         # quantized-gradient path: Pallas int8-MXU kernel on TPU, the
         # bit-identical XLA formulation elsewhere (ops/hist_pallas.py).
@@ -230,13 +318,13 @@ def histogram_leafbatch(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                     bins, grad, hess, col_id, col_ok, num_cols,
                     num_bins_max, axis_name=axis_name,
                     int_reduce=int_reduce, stochastic=stochastic,
-                    salt=salt))
+                    salt=salt, packing=packing))
         telemetry.count("hist/xla_int8")
         with telemetry.span("histogram") as sp:
             return sp.fence(hist_quant_xla(
                 bins, grad, hess, col_id, col_ok, num_cols, num_bins_max,
                 chunk=chunk, axis_name=axis_name, int_reduce=int_reduce,
-                stochastic=stochastic, salt=salt))
+                stochastic=stochastic, salt=salt, packing=packing))
     # float dtypes on TPU: hand-scheduled Pallas kernel with bf16 operands
     # (f32 rides a hi/lo operand split — one 5-stat pass for narrow
     # levels, two 3-stat passes wider).  This routes AROUND the XLA
@@ -254,9 +342,23 @@ def histogram_leafbatch(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         with telemetry.span("histogram") as sp:
             return sp.fence(hist_pallas_float_leafbatch(
                 bins, grad, hess, col_id, col_ok, num_cols, num_bins_max,
-                precision=precision))
+                precision=precision, packing=packing))
     telemetry.count("hist/xla_einsum")
     with jax.named_scope("histogram"), telemetry.span("histogram") as sp:
+        if _packing_active(packing):
+            # per-class einsum passes at the uniform pass's resolved chunk
+            # (identical scan grouping -> bit-identical f32 cells)
+            eff_chunk = _einsum_chunk(chunk, bins.shape[0], num_bins_max,
+                                      jnp.dtype(compute_dtype).itemsize,
+                                      bins.shape[1])
+            parts = []
+            for start, cnt, width in packing.ranges:
+                parts.append(_leafbatch_einsum(
+                    jax.lax.slice_in_dim(bins, start, start + cnt, axis=0),
+                    grad, hess, col_id, col_ok, num_cols, width,
+                    chunk=eff_chunk, compute_dtype=compute_dtype))
+            return sp.fence(_assemble_classes(
+                parts, packing, num_bins_max, feat_axis=1, bin_axis=2))
         return sp.fence(_leafbatch_einsum(
             bins, grad, hess, col_id, col_ok, num_cols, num_bins_max,
             chunk=chunk, compute_dtype=compute_dtype))
@@ -299,10 +401,7 @@ def _leafbatch_einsum(bins, grad, hess, col_id, col_ok, num_cols: int,
     # einsum operand rather than materializing [F, chunk, B] (validated at
     # 7.5 GB virtual on a 16 GB chip), but clamp the virtual size anyway so
     # very wide datasets degrade to smaller chunks instead of risking OOM.
-    itemsize = jnp.dtype(compute_dtype).itemsize
-    budget_rows = max(LEAFBATCH_VIRTUAL_BUDGET // (F * B * itemsize), 256)
-    chunk = min(chunk, -(-budget_rows // 256) * 256)
-    chunk = min(chunk, max(256, -(-N // 256) * 256))
+    chunk = _einsum_chunk(chunk, F, B, jnp.dtype(compute_dtype).itemsize, N)
     pad = (-N) % chunk
     if pad:
         bins = jnp.pad(bins, ((0, 0), (0, pad)))
@@ -336,11 +435,16 @@ def _leafbatch_einsum(bins, grad, hess, col_id, col_ok, num_cols: int,
 def histogram_leafbatch_segsum(bins, grad, hess, col_id, col_ok,
                                num_cols: int, num_bins_max: int,
                                chunk: int = 0, compute_dtype=None,
-                               axis_name=None, int_reduce=None, salt=0):
+                               axis_name=None, int_reduce=None, salt=0,
+                               packing=None):
     """Scatter-add leaf-batched histogram — CPU-fast oracle with the same
     [C, F, B, 3] contract as histogram_leafbatch (scatter beats the dense
     one-hot matmul off-TPU; summation ORDER differs, so f32 sums match the
-    matmul only to reduction noise)."""
+    matmul only to reduction noise).  ``packing``: the oracle just
+    un-permutes the packed bin matrix first — one F-row gather buys exact
+    uniform-path semantics."""
+    if _packing_active(packing):
+        bins = _unpack_bins(bins, packing)
     F, N = bins.shape
     B = num_bins_max
     C = num_cols
@@ -358,12 +462,14 @@ def histogram_leafbatch_segsum(bins, grad, hess, col_id, col_ok,
 def hist_quant_segsum(bins, grad, hess, col_id, col_ok, num_cols: int,
                       num_bins_max: int, chunk: int = 0, rng_bits=None,
                       compute_dtype=None, axis_name=None, int_reduce=None,
-                      salt=0):
+                      salt=0, packing=None):
     """Scatter-add variant of the quantized-gradient histogram — exact
     int32 accumulation, so it is bit-identical to hist_pallas/hist_quant_xla
     (ops/hist_pallas.py) at any summation order; the CPU-fast oracle for
     int8-path quality tests."""
     from .hist_pallas import quantize_values
+    if _packing_active(packing):
+        bins = _unpack_bins(bins, packing)
     F, N = bins.shape
     B = num_bins_max
     C = num_cols
@@ -388,8 +494,11 @@ def hist_quant_segsum(bins, grad, hess, col_id, col_ok, num_cols: int,
 
 
 def histogram_segsum(bins: jax.Array, grad: jax.Array, hess: jax.Array,
-                     mask: jax.Array, num_bins_max: int) -> jax.Array:
+                     mask: jax.Array, num_bins_max: int,
+                     packing=None) -> jax.Array:
     """Scatter-add backend (CPU-friendly, used by tests as an oracle)."""
+    if _packing_active(packing):
+        bins = _unpack_bins(bins, packing)
     F, N = bins.shape
     B = num_bins_max
     maskf = mask.astype(jnp.float32)
@@ -404,11 +513,12 @@ def histogram_segsum(bins: jax.Array, grad: jax.Array, hess: jax.Array,
 def build_histogram(bins, grad, hess, mask, num_bins_max, *,
                     backend: str = "matmul", chunk: int = 16384,
                     compute_dtype=jnp.float32, axis_name=None,
-                    int_reduce=None, salt=0) -> jax.Array:
+                    int_reduce=None, salt=0, packing=None) -> jax.Array:
     """``int_reduce``: optional int-domain cross-shard reduction for the
     quantized path (feature axis 0) — the data-parallel reduce_scatter
     ownership schedule passes a psum_scatter here so the accumulators are
-    scattered WITHOUT leaving the exact int domain."""
+    scattered WITHOUT leaving the exact int domain.  ``packing``: static
+    mixed-bin layout spec (see histogram_leafbatch)."""
     if str(compute_dtype).startswith("int8"):
         # single-leaf quantized pass == leaf-batched with one column
         N = bins.shape[1]
@@ -417,7 +527,8 @@ def build_histogram(bins, grad, hess, mask, num_bins_max, *,
                                   num_bins_max, chunk=chunk,
                                   compute_dtype=compute_dtype,
                                   axis_name=axis_name,
-                                  int_reduce=int_reduce, salt=salt)
+                                  int_reduce=int_reduce, salt=salt,
+                                  packing=packing)
         return out[0]
     if backend == "matmul":
         if _pallas_hist_ok(num_bins_max):
@@ -428,10 +539,13 @@ def build_histogram(bins, grad, hess, mask, num_bins_max, *,
             cid = jnp.zeros((bins.shape[1],), jnp.int32)
             out = histogram_leafbatch(bins, grad, hess, cid, mask, 1,
                                       num_bins_max, chunk=chunk,
-                                      compute_dtype=compute_dtype)
+                                      compute_dtype=compute_dtype,
+                                      packing=packing)
             return out[0]
         return histogram_matmul(bins, grad, hess, mask, num_bins_max,
-                                chunk=chunk, compute_dtype=compute_dtype)
+                                chunk=chunk, compute_dtype=compute_dtype,
+                                packing=packing)
     if backend == "segsum":
-        return histogram_segsum(bins, grad, hess, mask, num_bins_max)
+        return histogram_segsum(bins, grad, hess, mask, num_bins_max,
+                                packing=packing)
     raise ValueError(f"unknown histogram backend {backend!r}")

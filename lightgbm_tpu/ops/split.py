@@ -61,6 +61,15 @@ def find_best_split(hist: jax.Array, sum_grad: jax.Array, sum_hess: jax.Array,
     sum_grad, sum_hess, num_data : leaf totals (raw, no epsilon)
     num_bins : [F] int32 — real bin count per feature (B is padded)
     feature_mask : [F] bool — feature_fraction sampling / ownership masks
+
+    Mixed-bin invariant (ISSUE 6): under feature packing the histogram
+    routes hand back CANONICAL feature order with narrow-class features
+    zero-padded from their class width up to B — exactly the zeros the
+    uniform pass puts there (no row carries a bin >= the feature's own
+    num_bin), and the ``thresholds <= num_bins - 2`` validity mask below
+    never admits the padding as a candidate.  This function therefore
+    needs no packing awareness, and the across-feature argmax tie-break
+    (smaller CANONICAL index wins) is identical packed or not.
     """
     with telemetry.span("split_find") as sp:
         return sp.fence(_find_best_split_impl(

@@ -240,6 +240,12 @@ class _ParallelLearnerBase:
             min_data_in_leaf=self.tree_config.min_data_in_leaf,
             min_sum_hessian_in_leaf=self.tree_config.min_sum_hessian_in_leaf,
             max_depth=self.tree_config.max_depth,
+            # mixed-bin layout spec (None for the feature-parallel
+            # learner — gbdt.init resolves packing off there).  The
+            # per-class histograms reassemble into canonical feature
+            # order BEFORE any reduction, so the ownership psum_scatter
+            # and owned-slice seams below ride unchanged.
+            packing=getattr(gbdt, "_pack_spec", None),
             **_tuning_kwargs(self.tree_config.hist_chunk,
                              self.tree_config.hist_dtype,
                              self.tree_config.quant_rounding))

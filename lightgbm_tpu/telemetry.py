@@ -961,6 +961,17 @@ def span(name: str):
 
 
 # ----------------------------------------------------------------- counters
+#
+# Mixed-bin packing counters (ISSUE 6): the histogram routing layer files
+# ``hist/mixedbin_*`` trace-time counters (``_leafbatch`` = a packed
+# leaf-batched dispatch; ``_pallas_int``/``_pallas_float``/``_xla_int``/
+# ``_matmul`` = which kernel route ran the per-class passes) and
+# gbdt.init records the layout decision once per booster via
+# ``count_route("hist_layout", "hist/mixedbin_on"|"hist/mixedbin_off")``
+# — the runtime answer to "did this run actually pack, and on which
+# kernels".  Pipelined boosting deliberately adds NO counters: it changes
+# host wait order only, and the phase spans (model_readback migrating off
+# the critical path) are the observable.
 
 def count(name: str, n: int = 1) -> None:
     """Bump a monotonic counter (kernel-route decisions, env-var trips,

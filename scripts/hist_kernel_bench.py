@@ -8,6 +8,12 @@ Variants (all build the same [C, F, B, 3]-shaped level histogram):
            idea recast as an MXU matmul)
 
 Usage: python scripts/hist_kernel_bench.py --rows 4000000 --cols 42
+
+``--sweep-classes`` (ISSUE 6) instead runs the bin-width-class sweep: the
+same leaf-batched pass at B=63, B=255, and the MIXED per-class schedule
+(narrow features at 64 bins + wide at 255 via a PackSpec) so the packing
+threshold (io/binning.NARROW_BINS) can be re-derived from measurement when
+kernel economics change, instead of folklore.
 """
 from __future__ import annotations
 
@@ -34,10 +40,20 @@ def main():
     p.add_argument("--chunk", type=int, default=65536)
     p.add_argument("--variants", default="bf16,int8")
     p.add_argument("--pallas-chunk", type=int, default=2048)
+    p.add_argument("--sweep-classes", action="store_true",
+                   help="bin-width-class sweep: 63-wide vs 255-wide vs "
+                        "the mixed per-class schedule on the same rows "
+                        "(re-derives the packing threshold from data)")
+    p.add_argument("--narrow-frac", type=float, default=6 / 7,
+                   help="fraction of features in the narrow class for "
+                        "the mixed lane of --sweep-classes")
     args = p.parse_args()
 
     rng = np.random.RandomState(0)
     N, F, B, C = args.rows, args.features, args.bins, args.cols
+
+    if args.sweep_classes:
+        return sweep_classes(args, rng)
     bins = jnp.asarray(rng.randint(0, B, size=(F, N), dtype=np.int32)
                        .astype(np.int8))
     grad = jnp.asarray(rng.randn(N).astype(np.float32) * 0.3)
@@ -66,6 +82,50 @@ def main():
         gbps = per_pass_bytes / t / 1e9
         print(f"{v:6s} rows={N} C={C} chunk={args.chunk}: "
               f"{t*1e3:8.2f} ms/pass  ({gbps:6.1f} GB/s effective)")
+
+
+def sweep_classes(args, rng):
+    """63-wide vs 255-wide vs mixed per-class passes on identical rows.
+
+    The mixed lane builds a real PackSpec (narrow features first, 64-wide
+    class; wide features at 255) and calls histogram_leafbatch with it —
+    the exact production schedule, so the printed ratio IS the headline
+    histogram speedup a dataset with this narrow fraction can expect, and
+    the 63-vs-255 lanes bound it from both sides."""
+    from lightgbm_tpu.io.binning import PackSpec
+    N, F, C = args.rows, args.features, args.cols
+    n_narrow = max(1, min(F - 1, int(round(F * args.narrow_frac))))
+    grad = jnp.asarray(rng.randn(N).astype(np.float32) * 0.3)
+    hess = jnp.asarray(rng.rand(N).astype(np.float32) * 0.25)
+    col_id = jnp.asarray(rng.randint(0, C, size=N).astype(np.int32))
+    col_ok = jnp.asarray(rng.rand(N) < 0.9)
+    per_pass_bytes = N * (F + 13)
+
+    def bins_of(widths):
+        return jnp.asarray(np.stack(
+            [rng.randint(0, w, size=N) for w in widths]).astype(np.int8))
+
+    lanes = [
+        ("b63", bins_of([63] * F), 63, None),
+        ("b255", bins_of([255] * F), 255, None),
+        ("mixed", bins_of([64] * n_narrow + [255] * (F - n_narrow)), 255,
+         PackSpec(widths=(64, 255), counts=(n_narrow, F - n_narrow),
+                  perm=tuple(range(F)))),
+    ]
+    results = {}
+    for name, bins, B, spec in lanes:
+        op = lambda g, h, _b=bins, _B=B, _s=spec: histogram_leafbatch(
+            _b, g, h, col_id, col_ok, C, _B, chunk=args.chunk,
+            packing=_s)
+        t = device_time(op, grad, hess, key_arg=0, reps=(2, 6))
+        results[name] = t
+        gbps = per_pass_bytes / t / 1e9
+        print(f"{name:6s} rows={N} F={F} C={C}"
+              f"{'' if spec is None else ' narrow=%d' % n_narrow}: "
+              f"{t*1e3:8.2f} ms/pass  ({gbps:6.1f} GB/s effective)")
+    print(f"mixed vs b255 speedup: {results['b255'] / results['mixed']:.2f}x"
+          f"  (b63 bound: {results['b255'] / results['b63']:.2f}x)")
+    return 0
 
 
 if __name__ == "__main__":

@@ -59,6 +59,10 @@ RATE_KEYS: Tuple[Tuple[str, str], ...] = (
     ("parity_leafwise_f32_iters_per_sec", "parity_spread"),
     ("leafwise_int8_iters_per_sec", "leafwise_int8_spread"),
     ("maxbin63_iters_per_sec", "maxbin63_spread"),
+    # mixed-bin packed path, pinned explicitly ON (ISSUE 6): guards the
+    # per-class histogram schedule even if the headline's auto
+    # resolution ever changes
+    ("mixedbin_iters_per_sec", "mixedbin_spread"),
 )
 
 DEFAULT_FLOOR = 0.02      # minimum relative noise band when none recorded

@@ -1,0 +1,273 @@
+"""Mixed-bin feature packing (ISSUE 6): bit-identity vs the uniform-255
+oracle and the packing-plan rules.
+
+The contract under test: partitioning features into bin-width classes and
+running one histogram pass per class must be INVISIBLE to everything
+downstream — split decisions, thresholds, leaf values, scores bit-identical
+to the uniform single-pass path on every grower and both precision modes,
+serial and under the data-parallel reduce_scatter ownership schedule
+(per-class accumulators reassemble into canonical feature order BEFORE any
+reduction/argmax, so tie-breaks and ownership blocks never see the packed
+layout)."""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from lightgbm_tpu.config import OverallConfig
+from lightgbm_tpu.io.binning import (NARROW_BINS, PackSpec,
+                                     plan_feature_packing)
+from lightgbm_tpu.io.dataset import Dataset
+from lightgbm_tpu.models.gbdt import GBDT
+from lightgbm_tpu.objectives import create_objective
+
+
+# ------------------------------------------------------------- plan rules
+
+
+def test_plan_splits_classes_stably():
+    nb = np.array([254, 5, 254, 30, 2, 254], np.int32)
+    spec = plan_feature_packing(nb, 254)
+    assert spec.widths == (NARROW_BINS, 254)
+    assert spec.counts == (3, 3)
+    # stable within class: narrow features in canonical order, then wide
+    assert spec.perm == (1, 3, 4, 0, 2, 5)
+    # c2p inverts perm
+    for p, f in enumerate(spec.perm):
+        assert spec.c2p[f] == p
+    assert spec.ranges == ((0, 3, NARROW_BINS), (3, 3, 254))
+
+
+def test_plan_collapses_single_class():
+    # every feature wide -> no packing (the degenerate case the growers
+    # must serve via the single-class path)
+    assert plan_feature_packing(np.array([254, 200, 255]), 255) is None
+    # every feature narrow -> num_bins_max is already small; no packing
+    assert plan_feature_packing(np.array([5, 30, 2]), 30) is None
+    # empty
+    assert plan_feature_packing(np.array([], np.int32), 255) is None
+
+
+def test_plan_mode_and_env_hatch(monkeypatch):
+    nb = np.array([254, 5], np.int32)
+    assert plan_feature_packing(nb, 254, mode="false") is None
+    assert plan_feature_packing(nb, 254, mode="true") is not None
+    monkeypatch.setenv("LGBM_TPU_NO_MIXEDBIN", "1")
+    assert plan_feature_packing(nb, 254) is None
+
+
+def test_config_parses_mixed_bin():
+    cfg = OverallConfig()
+    cfg.set({"objective": "binary", "mixed_bin": "false"},
+            require_data=False)
+    assert cfg.boosting_config.tree_config.mixed_bin == "false"
+    with pytest.raises(Exception):
+        cfg.set({"objective": "binary", "mixed_bin": "sometimes"},
+                require_data=False)
+
+
+# ------------------------------------------------ end-to-end bit-identity
+
+
+def _mixed_dataset(n=2500, seed=3):
+    """Narrow (counts/flags) and wide (continuous) features interleaved."""
+    rng = np.random.RandomState(seed)
+    cont = rng.randn(n, 3)
+    x = np.stack([
+        cont[:, 0],
+        rng.randint(0, 5, n).astype(float),
+        rng.randint(0, 40, n).astype(float),
+        cont[:, 1],
+        (rng.rand(n) < 0.4).astype(float),
+        rng.randint(0, 3, n).astype(float),
+        cont[:, 2],
+    ], axis=1).astype(np.float64)
+    w = rng.randn(x.shape[1])
+    logits = (x - x.mean(0)) / (x.std(0) + 1e-9) @ w
+    y = (logits + rng.randn(n) > 0).astype(np.float32)
+    return Dataset.from_arrays(x, y, max_bin=255)
+
+
+@pytest.fixture(scope="module")
+def mixed_ds():
+    ds = _mixed_dataset()
+    # the fixture only makes sense if the data actually mixes classes
+    nb = ds.num_bins
+    assert (nb <= NARROW_BINS).any() and (nb > NARROW_BINS).any()
+    return ds
+
+
+def _train(ds, extra, iters=5, learner_kind=None):
+    params = {"objective": "binary", "num_leaves": "15",
+              "num_iterations": str(iters), "min_data_in_leaf": "20",
+              "min_sum_hessian_in_leaf": "5.0", "learning_rate": "0.1"}
+    params.update(extra)
+    cfg = OverallConfig()
+    cfg.set(params, require_data=False)
+    booster = GBDT()
+    obj = create_objective(cfg.objective_type, cfg.objective_config)
+    learner = None
+    if learner_kind is not None:
+        from lightgbm_tpu.parallel.learners import create_parallel_learner
+        cfg.boosting_config.tree_learner = learner_kind
+        learner = create_parallel_learner(cfg)
+    booster.init(cfg.boosting_config, ds, obj, learner=learner)
+    booster.run_training(iters, is_eval=False)
+    return booster
+
+
+def _assert_identical(b_on, b_off, tag):
+    assert b_on._pack_spec is not None, tag
+    assert b_off._pack_spec is None, tag
+    assert len(b_on.models) == len(b_off.models), tag
+    for t1, t2 in zip(b_on.models, b_off.models):
+        np.testing.assert_array_equal(t1.split_feature, t2.split_feature,
+                                      err_msg=tag)
+        np.testing.assert_array_equal(t1.threshold_bin, t2.threshold_bin,
+                                      err_msg=tag)
+        np.testing.assert_array_equal(np.asarray(t1.leaf_value),
+                                      np.asarray(t2.leaf_value),
+                                      err_msg=tag)
+        np.testing.assert_array_equal(np.asarray(t1.threshold),
+                                      np.asarray(t2.threshold),
+                                      err_msg=tag)
+    np.testing.assert_array_equal(np.asarray(b_on.score),
+                                  np.asarray(b_off.score), err_msg=tag)
+
+
+@pytest.mark.parametrize("hist_dtype", ["float32", "int8"])
+@pytest.mark.parametrize("grower", ["leafwise", "leafcompact", "depthwise"])
+def test_serial_bit_identity(mixed_ds, grower, hist_dtype):
+    extra = {"hist_dtype": hist_dtype}
+    if grower == "depthwise":
+        extra["grow_policy"] = "depthwise"
+    else:
+        extra["leafwise_compact"] = ("true" if grower == "leafcompact"
+                                     else "false")
+    on = _train(mixed_ds, dict(extra, mixed_bin="true"))
+    off = _train(mixed_ds, dict(extra, mixed_bin="false"))
+    _assert_identical(on, off, f"{grower}/{hist_dtype}")
+
+
+@pytest.mark.parametrize("hist_dtype", ["float32", "int8"])
+def test_dp_reduce_scatter_bit_identity(mixed_ds, hist_dtype):
+    """The per-class accumulators must ride the existing DP ownership
+    schedule: feature-block psum_scatter over the CANONICAL reassembled
+    histogram/int-accumulator, owned-slice search, SplitInfo allreduce —
+    packed == uniform, and (int8) == serial, bit for bit."""
+    extra = {"dp_schedule": "reduce_scatter", "hist_dtype": hist_dtype,
+             "leafwise_compact": "true"}
+    on = _train(mixed_ds, dict(extra, mixed_bin="true"),
+                learner_kind="data")
+    off = _train(mixed_ds, dict(extra, mixed_bin="false"),
+                 learner_kind="data")
+    _assert_identical(on, off, f"dp-rs/{hist_dtype}")
+    if hist_dtype == "int8":
+        serial = _train(mixed_ds, {"hist_dtype": "int8",
+                                   "leafwise_compact": "true",
+                                   "mixed_bin": "true"})
+        for t1, t2 in zip(on.models, serial.models):
+            np.testing.assert_array_equal(t1.split_feature,
+                                          t2.split_feature)
+            np.testing.assert_array_equal(t1.threshold_bin,
+                                          t2.threshold_bin)
+
+
+def test_dp_depthwise_chunk_bit_identity(mixed_ds):
+    extra = {"dp_schedule": "reduce_scatter", "grow_policy": "depthwise"}
+    on = _train(mixed_ds, dict(extra, mixed_bin="true"),
+                learner_kind="data", iters=10)
+    off = _train(mixed_ds, dict(extra, mixed_bin="false"),
+                 learner_kind="data", iters=10)
+    _assert_identical(on, off, "dp-rs/depthwise")
+
+
+def test_all_wide_collapses_to_single_class():
+    """Degenerate case: a continuous-only table must not pack at all —
+    mixed_bin=true resolves to the identity layout (pack spec None) and
+    training proceeds on the historical single-pass path."""
+    rng = np.random.RandomState(5)
+    x = rng.randn(1200, 5)
+    y = (x @ rng.randn(5) + rng.randn(1200) > 0).astype(np.float32)
+    ds = Dataset.from_arrays(x, y, max_bin=255)
+    assert (ds.num_bins > NARROW_BINS).all()
+    b = _train(ds, {"mixed_bin": "true"}, iters=3)
+    assert b._pack_spec is None
+    assert len(b.models) == 3
+
+
+def test_feature_parallel_keeps_uniform_layout(mixed_ds):
+    b = _train(mixed_ds, {"mixed_bin": "true"}, learner_kind="feature",
+               iters=3)
+    assert b._pack_spec is None
+    assert len(b.models) == 3
+
+
+def test_valid_scores_and_model_file_canonical(mixed_ds, tmp_path):
+    """Trees leave the booster in canonical/real feature space: the saved
+    model and validation-set replay must be identical packed vs not."""
+    rng = np.random.RandomState(9)
+    xv = np.stack([
+        rng.randn(400),
+        rng.randint(0, 5, 400).astype(float),
+        rng.randint(0, 40, 400).astype(float),
+        rng.randn(400),
+        (rng.rand(400) < 0.4).astype(float),
+        rng.randint(0, 3, 400).astype(float),
+        rng.randn(400),
+    ], axis=1).astype(np.float64)
+    yv = (rng.rand(400) > 0.5).astype(np.float32)
+    outs = {}
+    for mode in ("true", "false"):
+        params = {"objective": "binary", "num_leaves": "7",
+                  "num_iterations": "4", "min_data_in_leaf": "20",
+                  "min_sum_hessian_in_leaf": "5.0", "mixed_bin": mode}
+        cfg = OverallConfig()
+        cfg.set(params, require_data=False)
+        b = GBDT()
+        obj = create_objective(cfg.objective_type, cfg.objective_config)
+        b.init(cfg.boosting_config, mixed_ds, obj)
+        vd = Dataset.from_arrays(xv, yv, reference=mixed_ds)
+        from lightgbm_tpu.metrics import create_metric
+        b.add_valid_dataset(vd, [create_metric("binary_logloss",
+                                               cfg.metric_config)])
+        b.run_training(4, is_eval=True)
+        path = str(tmp_path / ("model_%s.txt" % mode))
+        b.save_model_to_file(True, path)
+        outs[mode] = (open(path).read(),
+                      np.asarray(b.valid_datasets[0]["score"]).copy(),
+                      b.predict(xv))
+    assert outs["true"][0] == outs["false"][0]
+    np.testing.assert_array_equal(outs["true"][1], outs["false"][1])
+    np.testing.assert_array_equal(outs["true"][2], outs["false"][2])
+
+
+def test_histogram_leafbatch_packed_matches_uniform():
+    """Kernel-level check on the XLA routes (f32 einsum + int8 einsum):
+    canonical-order histograms from the packed layout equal the uniform
+    pass cell for cell."""
+    from lightgbm_tpu.ops.histogram import histogram_leafbatch
+    rng = np.random.RandomState(1)
+    F, N, C, B = 7, 3000, 4, 200
+    num_bins = np.array([200, 5, 30, 200, 2, 60, 200])
+    bins = np.stack([rng.randint(0, nb, N)
+                     for nb in num_bins]).astype(np.uint8)
+    spec = plan_feature_packing(num_bins, B)
+    bins_packed = bins[np.asarray(spec.perm)]
+    grad = rng.randn(N).astype(np.float32)
+    hess = rng.rand(N).astype(np.float32)
+    cid = rng.randint(0, C, N).astype(np.int32)
+    ok = rng.rand(N) < 0.9
+    for dt in (jnp.float32, "int8"):
+        uni = histogram_leafbatch(
+            jnp.asarray(bins), jnp.asarray(grad), jnp.asarray(hess),
+            jnp.asarray(cid), jnp.asarray(ok), C, B, compute_dtype=dt)
+        packed = histogram_leafbatch(
+            jnp.asarray(bins_packed), jnp.asarray(grad),
+            jnp.asarray(hess), jnp.asarray(cid), jnp.asarray(ok), C, B,
+            compute_dtype=dt, packing=spec)
+        np.testing.assert_array_equal(np.asarray(uni), np.asarray(packed),
+                                      err_msg=str(dt))

@@ -1,0 +1,198 @@
+"""Pipelined boosting (ISSUE 6): pipeline=readback vs pipeline=off exact
+equivalence.
+
+The contract: pipelining only moves HOST WAITS (the model readback of
+iteration/chunk i is consumed after iteration/chunk i+1's dispatch) — the
+device work is dispatched in exactly the synchronous order, so trees,
+scores, metric values, early-stopping decisions and RNG streams are
+EXACT-identical, including when a stop (degenerate tree, early stopping)
+is discovered one consumption late and the surplus dispatched work must be
+rolled back from snapshots."""
+import numpy as np
+import pytest
+
+import jax
+
+from lightgbm_tpu.config import OverallConfig
+from lightgbm_tpu.io.dataset import Dataset
+from lightgbm_tpu.metrics import create_metric
+from lightgbm_tpu.models.gbdt import GBDT
+from lightgbm_tpu.objectives import create_objective
+
+
+def _data(n=2000, f=8, seed=11):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, f)
+    logits = x[:, 0] * 1.5 - x[:, 1] + 0.5 * x[:, 2] * x[:, 3]
+    y = (logits + rng.randn(n) * 0.5 > 0).astype(np.float32)
+    return x, y
+
+
+@pytest.fixture(scope="module")
+def train_ds():
+    x, y = _data()
+    return Dataset.from_arrays(x, y, max_bin=63)
+
+
+def _train(ds, extra, iters=6, valid=None, via="run_training",
+           is_eval=False):
+    params = {"objective": "binary", "num_leaves": "15",
+              "num_iterations": str(iters), "min_data_in_leaf": "20",
+              "min_sum_hessian_in_leaf": "5.0", "learning_rate": "0.1"}
+    params.update(extra)
+    cfg = OverallConfig()
+    cfg.set(params, require_data=False)
+    b = GBDT()
+    obj = create_objective(cfg.objective_type, cfg.objective_config)
+    b.init(cfg.boosting_config, ds, obj)
+    if valid is not None:
+        vd = Dataset.from_arrays(valid[0], valid[1], reference=ds)
+        b.add_valid_dataset(vd, [create_metric("binary_logloss",
+                                               cfg.metric_config)])
+    if via == "run_training":
+        b.run_training(iters, is_eval=is_eval)
+    elif via == "iter":
+        for _ in range(iters):
+            if b.train_one_iter(is_eval=is_eval):
+                break
+        b.flush_pipeline()
+    elif via == "chunk":
+        b.train_chunk(iters, is_eval=is_eval)
+        b.flush_pipeline()
+    return b
+
+
+def _assert_equal(b1, b2, tag):
+    assert len(b1.models) == len(b2.models), (
+        tag, len(b1.models), len(b2.models))
+    assert b1.iter == b2.iter, (tag, b1.iter, b2.iter)
+    for t1, t2 in zip(b1.models, b2.models):
+        np.testing.assert_array_equal(t1.split_feature, t2.split_feature,
+                                      err_msg=tag)
+        np.testing.assert_array_equal(t1.threshold_bin, t2.threshold_bin,
+                                      err_msg=tag)
+        np.testing.assert_array_equal(np.asarray(t1.leaf_value),
+                                      np.asarray(t2.leaf_value),
+                                      err_msg=tag)
+    np.testing.assert_array_equal(np.asarray(b1.score),
+                                  np.asarray(b2.score), err_msg=tag)
+    for e1, e2 in zip(b1.valid_datasets, b2.valid_datasets):
+        np.testing.assert_array_equal(np.asarray(e1["score"]),
+                                      np.asarray(e2["score"]),
+                                      err_msg=tag)
+
+
+@pytest.mark.parametrize("grow", ["leafwise", "depthwise"])
+def test_pipeline_exact_equivalence(train_ds, grow):
+    extra = {"grow_policy": grow} if grow == "depthwise" else {}
+    on = _train(train_ds, dict(extra, pipeline="readback"), iters=8)
+    off = _train(train_ds, dict(extra, pipeline="off"), iters=8)
+    _assert_equal(on, off, grow)
+
+
+def test_pipeline_with_bagging_and_feature_fraction(train_ds):
+    """The deferred path must replay the synchronous RNG stream exactly:
+    bagging redraw cadence and per-class feature sampling included."""
+    extra = {"bagging_fraction": "0.7", "bagging_freq": "2",
+             "feature_fraction": "0.75"}
+    on = _train(train_ds, dict(extra, pipeline="readback"), iters=8)
+    off = _train(train_ds, dict(extra, pipeline="off"), iters=8)
+    _assert_equal(on, off, "bagged")
+    # RNG streams ended at the same point: one more draw matches
+    assert (on._bag_rng.randint(1 << 30)
+            == off._bag_rng.randint(1 << 30))
+
+
+def test_pipeline_eval_and_early_stopping(train_ds):
+    """Early stopping is discovered at consumption, one call after the
+    surplus iteration was dispatched — the rollback must leave models,
+    scores, valid scores and the stop iteration exactly synchronous."""
+    rng = np.random.RandomState(99)
+    xv = rng.randn(500, 8)            # label noise, uncorrelated with x:
+    yv = (rng.rand(500) > 0.5).astype(np.float32)   # -> stops early
+    extra = {"metric": "binary_logloss", "early_stopping_round": "1",
+             "metric_freq": "1"}
+    on = _train(train_ds, dict(extra, pipeline="readback"), iters=30,
+                valid=(xv, yv), is_eval=True)
+    off = _train(train_ds, dict(extra, pipeline="off"), iters=30,
+                 valid=(xv, yv), is_eval=True)
+    assert on.iter < 30, "test premise: early stopping must trigger"
+    _assert_equal(on, off, "early-stop")
+    assert on.best_score == off.best_score
+    assert on.best_iter == off.best_iter
+
+
+def test_pipeline_degenerate_stop_rollback(train_ds):
+    """A degenerate (unsplittable) iteration is discovered one call late;
+    the already-dispatched next iteration must be rolled back wholesale.
+    min_data_in_leaf > N/2 makes the very first root split impossible."""
+    extra = {"min_data_in_leaf": "1500"}
+    on = _train(train_ds, dict(extra, pipeline="readback"), iters=5)
+    off = _train(train_ds, dict(extra, pipeline="off"), iters=5)
+    assert len(off.models) == 0 and off.iter == 0, "premise: degenerate"
+    _assert_equal(on, off, "degenerate")
+
+
+def test_pipeline_chunked_equivalence(train_ds):
+    """Chunk-level pipelining: chunk N dispatches before chunk N-1's
+    readback is consumed; run_training's chunk loop plus the final flush
+    must land the identical state, including a truncated tail chunk."""
+    extra = {"grow_policy": "depthwise"}
+    # 20 iterations at chunk_size 8 -> 2 full chunks + a limit-4 tail
+    on = _train(train_ds, dict(extra, pipeline="readback"), iters=20)
+    off = _train(train_ds, dict(extra, pipeline="off"), iters=20)
+    _assert_equal(on, off, "chunk-tail")
+
+
+def test_pipeline_direct_chunk_calls(train_ds):
+    """Direct train_chunk callers (bench.py) with pipeline=readback:
+    every call consumes the previous chunk; flush_pipeline drains the
+    last one."""
+    extra = {"grow_policy": "depthwise"}
+    on = _train(train_ds, dict(extra, pipeline="readback"), iters=8,
+                via="chunk")
+    off = _train(train_ds, dict(extra, pipeline="off"), iters=8,
+                 via="chunk")
+    _assert_equal(on, off, "direct-chunk")
+
+
+def test_pipeline_auto_off_for_direct_calls(train_ds):
+    """pipeline=auto engages only inside run_training: direct
+    train_one_iter callers keep synchronous semantics (models complete
+    after every call)."""
+    params = {"objective": "binary", "num_leaves": "7",
+              "num_iterations": "2", "min_data_in_leaf": "20",
+              "min_sum_hessian_in_leaf": "5.0"}
+    cfg = OverallConfig()
+    cfg.set(params, require_data=False)
+    assert cfg.boosting_config.pipeline == "auto"
+    b = GBDT()
+    obj = create_objective(cfg.objective_type, cfg.objective_config)
+    b.init(cfg.boosting_config, train_ds, obj)
+    b.train_one_iter(is_eval=False)
+    assert len(b.models) == 1, "auto must stay synchronous outside " \
+                               "run_training"
+    assert b._pipe is None and b._pipe_chunk is None
+
+
+def test_pipeline_env_hatch(train_ds, monkeypatch):
+    """LGBM_TPU_PIPELINE=off beats a config that forces readback (A/B
+    timing hatch)."""
+    monkeypatch.setenv("LGBM_TPU_PIPELINE", "off")
+    params = {"objective": "binary", "num_leaves": "7",
+              "num_iterations": "2", "min_data_in_leaf": "20",
+              "min_sum_hessian_in_leaf": "5.0", "pipeline": "readback"}
+    cfg = OverallConfig()
+    cfg.set(params, require_data=False)
+    b = GBDT()
+    obj = create_objective(cfg.objective_type, cfg.objective_config)
+    b.init(cfg.boosting_config, train_ds, obj)
+    b.train_one_iter(is_eval=False)
+    assert len(b.models) == 1 and b._pipe is None
+
+
+def test_pipeline_config_rejects_unknown():
+    cfg = OverallConfig()
+    with pytest.raises(Exception):
+        cfg.set({"objective": "binary", "pipeline": "sideways"},
+                require_data=False)
