@@ -123,6 +123,156 @@ def make_data(rows: int, features: int, seed: int = 42,
     return x.astype(np.float64), y
 
 
+# keys the headline bench copies out of the --bench-predict subprocess
+# (scripts/perf_gate.py RATE_KEYS gates the rows/sec entries; latency and
+# A/B keys ride along ungated)
+PREDICT_COPY_KEYS = (
+    "predict_b65536_rows_per_sec", "predict_b65536_spread",
+    "predict_b65536_p50_ms", "predict_b65536_p99_ms",
+    "predict_b1024_rows_per_sec", "predict_b1024_spread",
+    "predict_b32_rows_per_sec", "predict_b32_spread",
+    "predict_b1_p50_ms", "predict_b1_p99_ms",
+    "predict_int8_b65536_rows_per_sec", "predict_int8_b65536_spread",
+    "predict_scan_b65536_rows_per_sec", "predict_bfs_vs_scan_64k",
+    "predict_recompiles",
+)
+
+
+def bench_predict(args) -> int:
+    """Serving lane: predictions/sec + latency percentiles per bucket.
+
+    Trains a model on min(--rows, 1M) rows (the serving number prices the
+    ENGINE, not the trainer — 1M keeps the model-build bounded), then
+    times ``ServingEngine.scores`` at each bucket shape.  Every timed
+    call is end-to-end serving work: host rank-encode, pad-to-bucket,
+    compiled device walk, readback — the number a latency SLO actually
+    sees.  The per-tree-scan A/B at the 64k bucket is the acceptance
+    number for the breadth-first engine (ISSUE 7)."""
+    import jax  # noqa: F401  (device init before timing)
+    from lightgbm_tpu import costmodel, telemetry
+    from lightgbm_tpu.config import OverallConfig
+    from lightgbm_tpu.io.dataset import Dataset
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.objectives import create_objective
+    from lightgbm_tpu.serving import ServingEngine
+    from lightgbm_tpu.utils import log
+
+    log.set_stream(sys.stderr)
+    log.set_level(log.WARNING)
+    # armed telemetry = costmodel compile registry on: the lane asserts
+    # zero mid-run recompiles at the bucketed shapes (and the JSON gains
+    # the predict-phase roofline block).  fence=True: the engine fences
+    # its predict spans, so the roofline attained rates price the walk's
+    # execution, not its dispatch (PR 4 rule; wall-clock timing below is
+    # unaffected — scores() reads back synchronously either way)
+    telemetry.enable(fence=True)
+    telemetry.reset()
+
+    train_rows = min(args.rows, 1_000_000)
+    narrow = (args.narrow_features if args.narrow_features >= 0
+              else (args.features * 6) // 7)
+    x, y = make_data(train_rows, args.features, narrow_features=narrow)
+    ds = Dataset.from_arrays(x, y, max_bin=args.max_bin)
+    params = {
+        "objective": "binary",
+        "num_leaves": str(args.leaves),
+        "min_data_in_leaf": "100",
+        "min_sum_hessian_in_leaf": "10.0",
+        "learning_rate": "0.1",
+        "grow_policy": "depthwise",
+        "hist_dtype": args.hist_dtype,
+        "num_iterations": str(args.iters),
+    }
+    cfg = OverallConfig()
+    cfg.set(params, require_data=False)
+    booster = GBDT()
+    booster.init(cfg.boosting_config, ds,
+                 create_objective(cfg.objective_type, cfg.objective_config))
+    booster.train_chunk(args.iters)
+    booster.flush_pipeline()
+    T = len(booster.models)
+
+    buckets = (1, 32, 1024, 65536)
+    flat = booster.export_flat()
+    engines = {
+        "f32": ServingEngine(flat, buckets=buckets),
+        "int8": ServingEngine(flat, buckets=buckets, quantize="int8"),
+        "scan": ServingEngine(flat, buckets=buckets, algo="scan"),
+    }
+    xe, _ = make_data(buckets[-1], args.features, seed=7,
+                      narrow_features=narrow)
+
+    def measure(engine, n):
+        """(rows/sec samples, per-call latencies s).  One warm call
+        compiles; each repeat times enough calls to fill ~0.5 s wall."""
+        batch = xe[:n]
+        engine.scores(batch)
+        samples, lats = [], []
+        for _ in range(max(1, args.repeats)):
+            calls, t0 = 0, time.perf_counter()
+            while calls < 3 or time.perf_counter() - t0 < 0.5:
+                c0 = time.perf_counter()
+                engine.scores(batch)
+                lats.append(time.perf_counter() - c0)
+                calls += 1
+                if calls >= 500:
+                    break
+            samples.append(n * calls / (time.perf_counter() - t0))
+        return samples, lats
+
+    out = {
+        "metric": f"predict_rows_per_sec_higgs{train_rows // 1000}k_"
+                  f"trees{T}_leaves{args.leaves}",
+        "unit": "rows/sec",
+        "host": costmodel.host_fingerprint(),
+        "trees": T,
+    }
+
+    def record(prefix, samples, lats):
+        med = float(np.median(samples))
+        out[f"{prefix}_rows_per_sec"] = round(med, 2)
+        out[f"{prefix}_spread"] = round(
+            (max(samples) - min(samples)) / med, 4) if med > 0 else 0.0
+        out[f"{prefix}_p50_ms"] = round(
+            1e3 * float(np.percentile(lats, 50)), 4)
+        out[f"{prefix}_p99_ms"] = round(
+            1e3 * float(np.percentile(lats, 99)), 4)
+        return med
+
+    for b in buckets:
+        samples, lats = measure(engines["f32"], b)
+        med = record(f"predict_b{b}", samples, lats)
+        if b == buckets[-1]:
+            out["value"] = round(med, 2)
+            out["samples"] = [round(s, 2) for s in samples]
+            out["spread"] = out[f"predict_b{b}_spread"]
+    # steady-state contract: the f32 bucketed ladder compiled during
+    # warmup; everything after (timed loops, the int8/scan lanes, one
+    # more full ladder sweep) must not add ONE f32 program signature
+    def _f32_programs():
+        return len([r for r in costmodel.phase_program_records("predict")
+                    if r["name"] == "serve/bfs_scores"])
+
+    base_programs = _f32_programs()
+    samples, lats = measure(engines["int8"], buckets[-1])
+    record(f"predict_int8_b{buckets[-1]}", samples, lats)
+    samples, lats = measure(engines["scan"], buckets[-1])
+    record(f"predict_scan_b{buckets[-1]}", samples, lats)
+    out["predict_bfs_vs_scan_64k"] = round(
+        out[f"predict_b{buckets[-1]}_rows_per_sec"]
+        / max(out[f"predict_scan_b{buckets[-1]}_rows_per_sec"], 1e-9), 4)
+    for b in buckets:
+        engines["f32"].scores(xe[:b])
+    out["predict_recompiles"] = _f32_programs() - base_programs
+    snap = telemetry.snapshot()
+    if "roofline" in snap:
+        out["roofline"] = snap["roofline"]
+    if "compile" in snap:
+        out["compile"] = snap["compile"]
+    print(json.dumps(out))
+    return 0
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     # 11M rows is the headline scale (BASELINE.md north star: Higgs-11M,
@@ -181,7 +331,16 @@ def main() -> int:
                              "chunk/iteration dispatch against the "
                              "current model readback (bit-identical "
                              "results; 'off' = synchronous A/B)")
+    parser.add_argument("--bench-predict", action="store_true",
+                        help="serving benchmark (ISSUE 7): train a model "
+                             "(rows clamped to 1M, --iters trees), then "
+                             "measure the compiled serving engine's "
+                             "predictions/sec and p50/p99 latency per "
+                             "batch bucket (1/32/1k/64k), f32 and int8, "
+                             "plus the legacy per-tree-scan A/B at 64k")
     args = parser.parse_args()
+    if args.bench_predict:
+        return bench_predict(args)
     if (args.hist_dtype != "int8" and args.rows > 4_000_000
             and args.grow_policy == "depthwise"):
         # one fused dispatch of --iters f32 iterations at this scale would
@@ -518,6 +677,18 @@ def main() -> int:
                   [("mixedbin_iters_per_sec", "value"),
                    ("mixedbin_vs_cuda", "vs_cuda"),
                    ("mixedbin_spread", "spread")])
+
+    run_predict = not args.skip_parity
+    if run_predict:
+        # serving lane (ISSUE 7): predictions/sec + p50/p99 latency per
+        # batch bucket off the compiled serving engine, the int8-ensemble
+        # variant, and the legacy per-tree-scan A/B at 64k.  perf_gate
+        # gates predict_b65536/predict_int8_b65536/predict_b1024 rows/sec
+        # on the BENCH_r* trajectory next to the training rates.
+        sub_bench("predict",
+                  ["--bench-predict", "--max-bin", str(args.max_bin),
+                   "--iters", str(args.iters)],
+                  [(k, k) for k in PREDICT_COPY_KEYS])
 
     if run_maxbin63:
         # the reference's own speed configuration (max_bin=63,

@@ -14,6 +14,18 @@ ordinary ``key=value`` options, see config.py for semantics):
 ``pipeline`` (deferred-readback boosting, ISSUE 6).  ``grow_policy`` and
 ``hist_dtype`` are documented accuracy/order trades; all the others are
 model-invariant — flipping them changes speed, never trees.
+
+Serving knobs (``task=predict``, ISSUE 7 — lightgbm_tpu/serving.py):
+``predict_buckets`` (the compiled batch-shape ladder, default
+``1,32,1024,65536``; pad-to-bucket keeps steady-state serving at zero
+recompiles), ``predict_quantize`` (``float32`` = bit-equal to the
+training-side scorer; ``int8`` = quantized leaf values at a quarter of
+the table traffic — routing stays exact), ``predict_donate`` (donate the
+codes buffer; ``auto`` = accelerators only) and ``predict_algo``
+(``bfs`` lockstep breadth-first walk, ``scan`` = legacy per-tree replay
+for A/B).  All four are score transforms of the SAME model — only
+``predict_quantize=int8`` changes values, by the documented quantization
+step.
 """
 from __future__ import annotations
 
@@ -199,9 +211,12 @@ class Application:
         self.boosting = GBDT.from_model_file(self.config.io_config.input_model)
 
     def predict(self) -> None:
+        from .serving import engine_options_from_config
         predictor = Predictor(self.boosting, self.config.io_config.is_sigmoid,
                               self.config.predict_leaf_index,
-                              self.config.io_config.num_model_predict)
+                              self.config.io_config.num_model_predict,
+                              serving_options=engine_options_from_config(
+                                  self.config.io_config))
         predictor.predict_file(self.config.io_config.data_filename,
                                self.config.io_config.output_result,
                                self.config.io_config.has_header)

@@ -169,6 +169,28 @@ class IOConfig:
     input_init_score: str = ""
     verbosity: int = 1
     num_model_predict: int = -1
+    # Compiled serving engine (ISSUE 7, lightgbm_tpu/serving.py).
+    # predict_buckets: the CLOSED ladder of compiled batch shapes —
+    # batches pad up to the smallest bucket that holds them (larger
+    # inputs chunk at the biggest bucket), so steady-state serving never
+    # sees a new program shape and never recompiles.
+    predict_buckets: str = "1,32,1024,65536"
+    # predict_quantize: "int8" serves an int8-quantized leaf-value table
+    # (per-tree symmetric scale; quarter the table traffic — the
+    # memory-bound-ensemble mode).  Routing stays exact either way; only
+    # leaf VALUES are quantized.  "float32" is bit-equal to the
+    # training-side scorer.
+    predict_quantize: str = "float32"
+    # predict_donate: donate the padded codes buffer to the compiled
+    # program so steady-state serving recycles it in place.  "auto" = on
+    # for accelerator backends, off on CPU (which ignores donation with a
+    # per-call warning).
+    predict_donate: str = "auto"
+    # predict_algo: "bfs" walks all trees breadth-first in lockstep (one
+    # gather-based level step per depth — O(max_depth) fused steps);
+    # "scan" keeps the training-side per-tree replay (O(T·L) steps) as
+    # the A/B reference bench.py's bench_predict lane prices.
+    predict_algo: str = "bfs"
     is_pre_partition: bool = False
     is_enable_sparse: bool = True
     use_two_round_loading: bool = False
@@ -183,6 +205,20 @@ class IOConfig:
     weight_column: str = ""
     group_column: str = ""
     ignore_column: str = ""
+
+    def predict_bucket_list(self) -> tuple:
+        """The ``predict_buckets=`` ladder parsed and validated: sorted
+        unique positive ints (the serving engine's compiled batch
+        shapes)."""
+        try:
+            buckets = tuple(sorted({int(b) for b in
+                                    self.predict_buckets.split(",") if b}))
+        except ValueError:
+            log.fatal("predict_buckets should be comma-separated ints, "
+                      "passed is [%s]" % self.predict_buckets)
+        log.check(bool(buckets) and buckets[0] >= 1,
+                  "predict_buckets must contain positive ints")
+        return buckets
 
     def memory_stats_enabled(self) -> bool:
         """The ``memory_stats=`` resolution rule, single-homed (cli.py and
@@ -236,6 +272,24 @@ class IOConfig:
         log.check(self.stall_timeout >= 0.0,
                   "stall_timeout should be >= 0")
         self.num_model_predict = _get_int(params, "num_model_predict", self.num_model_predict)
+        self.predict_buckets = _get_str(params, "predict_buckets",
+                                        self.predict_buckets)
+        self.predict_bucket_list()  # validate eagerly: fail at parse time
+        if "predict_quantize" in params:
+            value = params["predict_quantize"].lower()
+            log.check(value in ("float32", "int8"),
+                      "predict_quantize must be float32 or int8")
+            self.predict_quantize = value
+        if "predict_donate" in params:
+            value = params["predict_donate"].lower()
+            log.check(value in ("auto", "true", "false"),
+                      "predict_donate must be auto, true or false")
+            self.predict_donate = value
+        if "predict_algo" in params:
+            value = params["predict_algo"].lower()
+            log.check(value in ("bfs", "scan"),
+                      "predict_algo must be bfs or scan")
+            self.predict_algo = value
         self.is_pre_partition = _get_bool(params, "is_pre_partition", self.is_pre_partition)
         self.is_enable_sparse = _get_bool(params, "is_enable_sparse", self.is_enable_sparse)
         self.use_two_round_loading = _get_bool(params, "use_two_round_loading",

@@ -482,6 +482,16 @@ def roofline(phase_times: Dict[str, float],
     return out
 
 
+def phase_program_records(phase: str) -> List[dict]:
+    """This generation's captured-program records filed under one phase
+    label (copies).  The serving no-recompile assertion reads this: a
+    steady-state bucketed engine must keep a CLOSED program inventory —
+    repeated calls at a bucket shape bump ``calls`` on existing records
+    and never add a new one (tests/test_serving.py, bench.py
+    bench_predict lane)."""
+    return [dict(r) for r in _records if r.get("phase") == phase]
+
+
 def compile_block() -> dict:
     """Run-level compile observability: captured-program inventory,
     total cold-compile seconds, and the telemetry compile counters

@@ -1741,71 +1741,51 @@ class GBDT:
     # rows x trees volume the host numpy walk wins
     _DEVICE_PREDICT_THRESHOLD = 20_000_000
 
+    def export_flat(self, num_models: int = -1):
+        """Flatten the first ``num_models`` trees (all when < 0) into a
+        serving.FlatEnsemble: stacked per-node tensors + the host-built
+        f64 rank-code tables.  This is the once-per-model encode the old
+        per-call ``_device_predict_encode`` re-ran on every predict."""
+        from ..serving import FlatEnsemble
+        models = self.models if num_models < 0 else self.models[:num_models]
+        return FlatEnsemble.from_models(models, self.num_class)
+
+    def serving_engine(self, num_models: int = -1, **options):
+        """The cached compiled serving engine over the first
+        ``num_models`` trees (serving.ServingEngine: bucketed batch
+        shapes, donated buffers, breadth-first lockstep scoring).  The
+        cache key includes the model count, so continued training (or a
+        pipeline rollback popping trees) re-flattens naturally."""
+        if num_models < 0:
+            num_models = len(self.models)
+        key = (len(self.models), num_models, tuple(sorted(options.items())))
+        cached = getattr(self, "_serve_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        from ..serving import ServingEngine
+        engine = ServingEngine(self.export_flat(num_models), **options)
+        self._serve_cache = (key, engine)
+        return engine
+
     def _device_predict_encode(self, features: np.ndarray, models):
-        """Rank-encode raw feature values against the union of the
-        ensemble's thresholds, in float64 on host — the integer replay on
-        device then routes rows EXACTLY like the reference's double
-        comparisons (tree.h:163-175), with no f32 threshold rounding."""
-        max_nodes = max(max((t.num_leaves - 1 for t in models), default=1), 1)
-        T = len(models)
-        used = sorted({int(f) for t in models
-                       for f in t.split_feature_real[:t.num_leaves - 1]})
-        fmap = {f: i for i, f in enumerate(used)}
-        thr = {f: [] for f in used}
-        for t in models:
-            for f, v in zip(t.split_feature_real, t.threshold):
-                thr[int(f)].append(float(v))
-        thr = {f: np.unique(np.asarray(v, np.float64)) for f, v in thr.items()}
-
-        N = features.shape[0]
-        codes = np.zeros((max(len(used), 1), N), np.int32)
-        for f, i in fmap.items():
-            # code = #{thresholds < x}; x > t_j  <=>  code > j, and an exact
-            # tie x == t_j gives code == j -> left, matching `value > t`
-            vals = features[:, f]
-            c = np.searchsorted(thr[f], vals, side="left")
-            # NaN sorts past every threshold; the host walk's `value > t`
-            # is False for NaN -> always left.  Match it.
-            c[np.isnan(vals)] = 0
-            codes[i] = c
-
-        sf = np.zeros((T, max_nodes), np.int32)
-        tr = np.zeros((T, max_nodes), np.int32)
-        lc = np.zeros((T, max_nodes), np.int32)
-        rc = np.zeros((T, max_nodes), np.int32)
-        lv = np.zeros((T, max_nodes + 1), np.float32)
-        nl = np.zeros((T,), np.int32)
-        for k, t in enumerate(models):
-            n = t.num_leaves - 1
-            nl[k] = t.num_leaves
-            lv[k, :t.num_leaves] = t.leaf_value
-            if n <= 0:
-                continue
-            sf[k, :n] = [fmap[int(f)] for f in t.split_feature_real[:n]]
-            tr[k, :n] = [int(np.searchsorted(thr[int(f)], float(v), "left"))
-                         for f, v in zip(t.split_feature_real[:n],
-                                         t.threshold[:n])]
-            lc[k, :n] = t.left_child[:n]
-            rc[k, :n] = t.right_child[:n]
-        return codes, (sf, tr, lc, rc, lv, nl), max_nodes
+        """Back-compat shim over serving.FlatEnsemble: rank-encoded codes
+        plus the stacked per-tree arrays (the old per-call flatten).  New
+        code should use export_flat()/serving_engine() — those cache the
+        flatten across calls."""
+        from ..serving import FlatEnsemble
+        flat = FlatEnsemble.from_models(models, self.num_class)
+        codes = flat.encode(features)
+        return codes, (flat.split_feature, flat.threshold_rank,
+                       flat.left_child, flat.right_child, flat.leaf_value,
+                       flat.num_leaves), flat.max_nodes
 
     def _predict_scores_device(self, features: np.ndarray,
                                models) -> np.ndarray:
-        """[num_class, N] raw ensemble sums on device (chunked rows)."""
-        from ..ops.scoring import ensemble_scores
-        codes, (sf, tr, lc, rc, lv, nl), max_nodes = \
-            self._device_predict_encode(features, models)
-        tc = jnp.asarray(np.arange(len(models)) % self.num_class, jnp.int32)
-        args = tuple(jnp.asarray(a) for a in (sf, tr, lc, rc, lv, nl))
-        N = features.shape[0]
-        chunk = 1 << 19
-        outs = []
-        for s in range(0, N, chunk):
-            out = ensemble_scores(jnp.asarray(codes[:, s:s + chunk]), *args,
-                                  tc, max_nodes=max_nodes,
-                                  num_class=self.num_class)
-            outs.append(np.asarray(out, np.float64))
-        return np.concatenate(outs, axis=1)
+        """[num_class, N] raw ensemble sums via the compiled serving
+        engine (models must be a prefix of self.models — every caller
+        passes self.models[:n])."""
+        engine = self.serving_engine(len(models))
+        return engine.scores(features)
 
     def predict_raw(self, features: np.ndarray,
                     num_used_model: int = -1) -> np.ndarray:
@@ -1857,19 +1837,7 @@ class GBDT:
         models = self.models[:num_used_model]
         if features.shape[0] * max(len(models), 1) \
                 >= self._DEVICE_PREDICT_THRESHOLD:
-            from ..ops.scoring import ensemble_leaf_indices
-            codes, (sf, tr, lc, rc, _, nl), max_nodes = \
-                self._device_predict_encode(features, models)
-            args = tuple(jnp.asarray(a) for a in (sf, tr, lc, rc, nl))
-            N = features.shape[0]
-            chunk = 1 << 19
-            outs = []
-            for s in range(0, N, chunk):
-                leaves = ensemble_leaf_indices(
-                    jnp.asarray(codes[:, s:s + chunk]), *args,
-                    max_nodes=max_nodes)
-                outs.append(np.asarray(leaves, np.int32).T)
-            return np.concatenate(outs, axis=0)
+            return self.serving_engine(len(models)).leaf_indices(features)
         cols = []
         for tree in models:
             if tree.num_leaves == 1:

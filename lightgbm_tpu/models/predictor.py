@@ -1,8 +1,11 @@
 """Batch predictor: parse → predict → write TSV results.
 
 Re-design of /root/reference/src/application/predictor.hpp:23-228.  Per-thread
-dense row buffers become a single dense feature matrix; predictions are
-vectorized tree replays (models/tree.py) rather than per-row walks.
+dense row buffers become a single dense feature matrix; predictions run
+through the compiled serving engine (lightgbm_tpu/serving.py): the
+ensemble is flattened ONCE in __init__ (not once per 500k-row chunk, as
+the old per-call device encode did), batches are padded to the engine's
+bucket ladder, and every chunk reuses the same compiled programs.
 Output modes match: multiclass tab-joined probabilities, leaf indices,
 sigmoid, or raw scores.
 """
@@ -16,39 +19,59 @@ from ..utils import log
 
 class Predictor:
     def __init__(self, boosting, is_sigmoid: bool, is_predict_leaf_index: bool,
-                 num_used_model: int):
+                 num_used_model: int, serving_options: dict = None):
         self.boosting = boosting
         self.is_sigmoid = is_sigmoid
         self.is_predict_leaf_index = is_predict_leaf_index
         self.num_used_model = num_used_model
         self.num_features = boosting.max_feature_idx + 1
         self.num_class = boosting.num_class
+        # engine built ONCE: predict_file's chunk loop must not re-flatten
+        # the ensemble per chunk (tests/test_serving.py pins the
+        # single-flatten behavior via serving.FLATTEN_COUNT)
+        if num_used_model < 0:
+            num_models = len(boosting.models)
+        elif self.num_class > 1:
+            num_models = num_used_model * self.num_class
+        else:
+            num_models = num_used_model
+        self.engine = boosting.serving_engine(num_models,
+                                              **(serving_options or {}))
 
     def predict_matrix(self, features: np.ndarray) -> np.ndarray:
         """Dense [N, num_features] → predictions (rows of the result file)."""
         if features.shape[1] < self.num_features:
+            # pad in the INPUT dtype: a float64 default here would silently
+            # upcast f32 feature matrices on concatenate
             pad = np.zeros((features.shape[0],
-                            self.num_features - features.shape[1]))
+                            self.num_features - features.shape[1]),
+                           dtype=features.dtype)
             features = np.concatenate([features, pad], axis=1)
         features = features[:, :max(self.num_features, 1)]
-        if self.num_class > 1:
-            return self.boosting.predict_multiclass(features,
-                                                    self.num_used_model)
         if self.is_predict_leaf_index:
-            return self.boosting.predict_leaf_index(features,
-                                                    self.num_used_model)
-        if self.is_sigmoid:
-            return self.boosting.predict(features, self.num_used_model)
-        return self.boosting.predict_raw(features, self.num_used_model)
+            return self.engine.leaf_indices(features)
+        scores = self.engine.scores(features)
+        if self.num_class > 1:
+            # softmax (gbdt.cpp:496-508), same transform as
+            # GBDT.predict_multiclass
+            out = scores.T
+            z = out - out.max(axis=1, keepdims=True)
+            p = np.exp(z)
+            return p / p.sum(axis=1, keepdims=True)
+        raw = scores[0]
+        if self.is_sigmoid and self.boosting.sigmoid > 0:
+            return 1.0 / (1.0 + np.exp(-2.0 * self.boosting.sigmoid * raw))
+        return raw
 
     def predict_file(self, data_filename: str, result_filename: str,
-                     has_header: bool) -> None:
+                     has_header: bool, chunk_lines: int = 500_000) -> None:
         """Predictor::Predict (predictor.hpp:109-197).
 
         Streams the file in bounded chunks (the reference predicts
         line-by-line off a pipelined reader; here a prefetcher thread
         reads the next chunk while the current one predicts), so the raw
-        feature matrix never materializes whole."""
+        feature matrix never materializes whole.  The ensemble encode is
+        NOT per-chunk: the engine built in __init__ carries it."""
         parser = parser_mod.create_parser(data_filename, has_header,
                                           self.num_features,
                                           self.boosting.label_idx)
@@ -56,7 +79,7 @@ class Predictor:
             for lines in parser_mod.prefetch_chunks(
                     parser_mod.read_line_chunks(
                         data_filename, skip_header=has_header,
-                        chunk_lines=500_000)):
+                        chunk_lines=chunk_lines)):
                 parsed = parser.parse(lines)
                 result = self.predict_matrix(parsed.features)
                 if result.ndim == 1:

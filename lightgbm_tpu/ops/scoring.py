@@ -14,7 +14,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from .lookup import exact_table_lookup
+from .lookup import batched_int8_table_lookup, exact_table_lookup
 
 
 @functools.partial(jax.jit, static_argnames=("max_nodes",))
@@ -136,6 +136,111 @@ def ensemble_leaf_indices(codes: jax.Array, split_feature: jax.Array,
         body, None, (split_feature, threshold_rank, left_child, right_child,
                      num_leaves))
     return leaves
+
+
+# ---------------------------------------------------------------- serving BFS
+#
+# The per-tree replay above is the TRAINING-side scorer: one lax.scan step
+# per tree, each replaying num_leaves-1 sequential masked splits — O(T·L)
+# dependent device steps.  The serving engine (lightgbm_tpu/serving.py)
+# instead walks ALL trees breadth-first in lockstep: the walk state is the
+# [T, N] frontier of current node ids, and one gather-based level step
+# advances every (tree, row) pair one depth at once — O(max_depth) fused
+# steps total, independent of the tree count.  Node ids reuse the tree.h
+# child encoding (>= 0 internal node, < 0 a bitwise-complemented leaf
+# ``~leaf``), so "row finished" is simply ``state < 0`` and the masked
+# step is branch-free.
+
+
+def _bfs_leaf_state(codes, split_feature, threshold_rank, left_child,
+                    right_child, root_state, max_depth: int):
+    """[T, N] leaf ids via the lockstep breadth-first walk.
+
+    ``codes`` [F, N] is the host-built integer rank encoding (same tables
+    as the replay path, so routing is EXACT); node tables are [T,
+    max_nodes]; ``root_state`` [T] is 0 for trees with nodes and ~0 for
+    single-leaf stumps.  Returns nonneg leaf indices [T, N]."""
+    T = split_feature.shape[0]
+    N = codes.shape[1]
+    state = jnp.broadcast_to(root_state[:, None], (T, N)).astype(jnp.int32)
+
+    def step(_, state):
+        node = jnp.maximum(state, 0)
+        sf = jnp.take_along_axis(split_feature, node, axis=1)
+        tr = jnp.take_along_axis(threshold_rank, node, axis=1)
+        lc = jnp.take_along_axis(left_child, node, axis=1)
+        rc = jnp.take_along_axis(right_child, node, axis=1)
+        code = jnp.take_along_axis(codes, sf, axis=0)
+        nxt = jnp.where(code > tr, rc, lc)
+        return jnp.where(state >= 0, nxt, state)
+
+    state = jax.lax.fori_loop(0, max_depth, step, state)
+    return -state - 1  # ~state: every row has reached a leaf by max_depth
+
+
+def _accumulate_tree_scores(vals, tree_class, num_class: int):
+    """Σ over trees of per-tree leaf values ``vals`` [T, N] f32, summed
+    per class IN TREE ORDER — the exact f32 accumulation sequence of
+    ``ensemble_scores``' scan (score.at[tc].add per tree), so the BFS
+    engine is bit-equal to the training-side scorer by construction."""
+    T, N = vals.shape
+    init = jnp.zeros((num_class, N), jnp.float32)
+
+    def add(t, score):
+        return score.at[tree_class[t]].add(vals[t])
+
+    return jax.lax.fori_loop(0, T, add, init)
+
+
+def bfs_scores_impl(codes, split_feature, threshold_rank, left_child,
+                    right_child, leaf_value, root_state, tree_class,
+                    *, max_depth: int, num_class: int):
+    """[num_class, N] raw ensemble sums, breadth-first (f32 ensemble).
+
+    The leaf read is a per-tree aligned gather (take_along_axis): the f32
+    leaf table is [T, max_leaves] and every (tree, row) reads its own
+    tree's row, so the read is exact by definition — the byte-split
+    one-hot trick is reserved for the int8 variant where a single bf16
+    pass suffices."""
+    leaf = _bfs_leaf_state(codes, split_feature, threshold_rank,
+                           left_child, right_child, root_state, max_depth)
+    vals = jnp.take_along_axis(leaf_value, leaf, axis=1)   # [T, N] f32
+    return _accumulate_tree_scores(vals, tree_class, num_class)
+
+
+def bfs_scores_int8_impl(codes, split_feature, threshold_rank, left_child,
+                         right_child, leaf_q, leaf_scale, root_state,
+                         tree_class, *, max_depth: int, num_class: int):
+    """int8-ensemble variant: leaf values ride as int8 [T, max_leaves]
+    plus a per-tree f32 dequantization scale.  The table read is the
+    single-pass bf16 one-hot matmul (batched_int8_table_lookup — int8
+    magnitudes are bf16-exact, so the read is exact; only the
+    quantization itself loses precision).  Accumulation order matches the
+    f32 path, so the scores are bit-equal to a host replay of the SAME
+    quantized model."""
+    leaf = _bfs_leaf_state(codes, split_feature, threshold_rank,
+                           left_child, right_child, root_state, max_depth)
+    qvals = batched_int8_table_lookup(leaf_q, leaf)        # [T, N] f32
+    vals = qvals * leaf_scale[:, None]
+    return _accumulate_tree_scores(vals, tree_class, num_class)
+
+
+def bfs_leaf_indices_impl(codes, split_feature, threshold_rank, left_child,
+                          right_child, root_state, *, max_depth: int):
+    """[T, N] leaf index per tree, breadth-first (PredictLeafIndex)."""
+    return _bfs_leaf_state(codes, split_feature, threshold_rank,
+                           left_child, right_child, root_state, max_depth)
+
+
+# Module-level jitted conveniences (tests, ad-hoc callers).  The serving
+# engine builds its OWN jits from the impls above so it can donate the
+# codes buffer and instrument each program through costmodel.
+ensemble_scores_bfs = jax.jit(
+    bfs_scores_impl, static_argnames=("max_depth", "num_class"))
+ensemble_scores_bfs_int8 = jax.jit(
+    bfs_scores_int8_impl, static_argnames=("max_depth", "num_class"))
+ensemble_leaf_indices_bfs = jax.jit(
+    bfs_leaf_indices_impl, static_argnames=("max_depth",))
 
 
 @functools.partial(jax.jit, static_argnames=("max_nodes",))

@@ -1,0 +1,385 @@
+"""Compiled serving engine: flattened ensembles, bucketed batch shapes,
+int8 scoring.
+
+The production predict front (ROADMAP item 1; reference semantics:
+``src/application/predictor.hpp:109-197``).  The training-side scorer
+replays trees one at a time (``ops/scoring.ensemble_scores``: a lax.scan
+with num_leaves-1 sequential masked steps per tree) and the old
+``GBDT._device_predict_encode`` re-flattened the WHOLE ensemble on host on
+every call.  This module splits serving into the two halves a steady-state
+server actually has:
+
+1. **FlatEnsemble** — built ONCE per trained model: the per-node tensors
+   stacked ``[T, max_nodes]`` (split_feature, threshold_rank, left/right
+   child), the ``[T, max_leaves]`` leaf-value table, and the host-built
+   f64 per-feature threshold rank tables that make integer routing EXACT
+   (no f32 threshold-comparison rounding — same encoding contract as
+   ``_device_predict_encode``).  ``FlatEnsemble.encode(features)`` is the
+   only per-batch host work: one ``np.searchsorted`` per used feature.
+
+2. **ServingEngine** — owns the compiled programs.  Batches are padded to
+   a fixed bucket ladder (default 1 / 32 / 1024 / 65536 rows) so
+   steady-state serving sees a CLOSED set of program shapes and never
+   recompiles; the codes buffer is donated (non-CPU backends) so the pad
+   buffer is recycled in place.  Scoring walks all trees breadth-first in
+   lockstep (``ops/scoring.bfs_scores_impl``): one gather-based level
+   step per depth over the whole [T, N] frontier — O(max_depth) fused
+   steps instead of the training scorer's O(T·L) — and accumulates leaf
+   values in tree order, so scores are BIT-EQUAL to the training-side
+   scorer.  ``quantize="int8"`` swaps the leaf table for int8 + per-tree
+   scale (quarter table traffic, single-pass bf16 one-hot read; routing
+   stays exact — only leaf VALUES are quantized).
+
+Programs are costmodel-instrumented under phase "predict" (roofline
+attribution + compile observability ride along whenever telemetry is
+armed), and the engine files ``serve/*`` counters.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import costmodel, telemetry
+
+DEFAULT_BUCKETS: Tuple[int, ...] = (1, 32, 1024, 65536)
+
+# module-level flatten counter: the regression test for the encode-once
+# contract (predict_file must flatten the ensemble exactly once across
+# its 500k-row chunks) reads the delta — independent of whether
+# telemetry is armed
+FLATTEN_COUNT = 0
+
+
+def _tree_max_depth(lc: np.ndarray, rc: np.ndarray, n: int) -> int:
+    """Depth (in edges from the root) a BFS walk needs to resolve every
+    row of this tree.  Children are always created AFTER their parent
+    (node k's children have indices > k, tree.cpp:70-71), so one forward
+    pass suffices."""
+    if n <= 0:
+        return 0
+    depth = np.ones(n, np.int32)
+    for k in range(n):
+        for c in (int(lc[k]), int(rc[k])):
+            if c >= 0:
+                depth[c] = depth[k] + 1
+    return int(depth.max())
+
+
+class FlatEnsemble:
+    """A trained ensemble flattened once into dense per-node tensors plus
+    the host-built f64 rank-code tables (see module docstring)."""
+
+    def __init__(self, used, thresholds, sf, tr, lc, rc, lv, nl, root,
+                 tree_class, max_nodes: int, max_depth: int,
+                 num_class: int):
+        self.used = used                 # original column ids, sorted
+        self.thresholds = thresholds     # {col: sorted unique f64 thresholds}
+        self.split_feature = sf          # [T, max_nodes] int32 (inner ids)
+        self.threshold_rank = tr         # [T, max_nodes] int32
+        self.left_child = lc             # [T, max_nodes] int32 (~leaf enc)
+        self.right_child = rc            # [T, max_nodes] int32
+        self.leaf_value = lv             # [T, max_nodes + 1] f32
+        self.num_leaves = nl             # [T] int32
+        self.root_state = root           # [T] int32: 0, or ~0 for stumps
+        self.tree_class = tree_class     # [T] int32
+        self.max_nodes = max_nodes
+        self.max_depth = max_depth
+        self.num_class = num_class
+        self.num_trees = sf.shape[0]
+        self._int8: Optional[Tuple[np.ndarray, np.ndarray]] = None
+
+    @classmethod
+    def from_models(cls, models, num_class: int) -> "FlatEnsemble":
+        """Flatten ``models`` (list of models.tree.Tree).  This is the
+        once-per-model cost the old per-call ``_device_predict_encode``
+        paid on EVERY predict call."""
+        global FLATTEN_COUNT
+        FLATTEN_COUNT += 1
+        telemetry.count("serve/ensemble_flatten")
+        T = len(models)
+        max_nodes = max(max((t.num_leaves - 1 for t in models), default=1),
+                        1)
+        used = sorted({int(f) for t in models
+                       for f in t.split_feature_real[:t.num_leaves - 1]})
+        fmap = {f: i for i, f in enumerate(used)}
+        thr = {f: [] for f in used}
+        for t in models:
+            for f, v in zip(t.split_feature_real, t.threshold):
+                thr[int(f)].append(float(v))
+        thr = {f: np.unique(np.asarray(v, np.float64))
+               for f, v in thr.items()}
+
+        sf = np.zeros((T, max_nodes), np.int32)
+        tr = np.zeros((T, max_nodes), np.int32)
+        lc = np.zeros((T, max_nodes), np.int32)
+        rc = np.zeros((T, max_nodes), np.int32)
+        lv = np.zeros((T, max_nodes + 1), np.float32)
+        nl = np.zeros((T,), np.int32)
+        root = np.zeros((T,), np.int32)
+        max_depth = 0
+        for k, t in enumerate(models):
+            n = t.num_leaves - 1
+            nl[k] = t.num_leaves
+            lv[k, :t.num_leaves] = t.leaf_value
+            if n <= 0:
+                root[k] = -1      # ~0: the stump's single leaf
+                continue
+            sf[k, :n] = [fmap[int(f)] for f in t.split_feature_real[:n]]
+            tr[k, :n] = [int(np.searchsorted(thr[int(f)], float(v), "left"))
+                         for f, v in zip(t.split_feature_real[:n],
+                                         t.threshold[:n])]
+            lc[k, :n] = t.left_child[:n]
+            rc[k, :n] = t.right_child[:n]
+            max_depth = max(max_depth,
+                            _tree_max_depth(lc[k], rc[k], n))
+        tc = (np.arange(T) % max(num_class, 1)).astype(np.int32)
+        return cls(used, thr, sf, tr, lc, rc, lv, nl, root, tc,
+                   max_nodes, max_depth, max(num_class, 1))
+
+    def encode(self, features: np.ndarray) -> np.ndarray:
+        """Rank-encode raw feature values against the ensemble's own
+        threshold tables, in float64 on host — the integer walk on device
+        then routes rows EXACTLY like the reference's double comparisons
+        (tree.h:163-175).  [F_used, N] int32; the only per-batch host
+        work."""
+        N = features.shape[0]
+        codes = np.zeros((max(len(self.used), 1), N), np.int32)
+        for i, f in enumerate(self.used):
+            # code = #{thresholds < x}; x > t_j  <=>  code > j, and an
+            # exact tie x == t_j gives code == j -> left (`value > t`)
+            vals = features[:, f]
+            c = np.searchsorted(self.thresholds[f], vals, side="left")
+            # NaN sorts past every threshold; the host walk's `value > t`
+            # is False for NaN -> always left.  Match it.
+            c[np.isnan(vals)] = 0
+            codes[i] = c
+        return codes
+
+    def int8_tables(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(leaf_q [T, max_leaves] int8, scale [T] f32), built lazily and
+        cached.  Symmetric per-tree quantization: scale = max|leaf|/127,
+        q = round(leaf/scale) — a leaf reads back as ``q * scale``."""
+        if self._int8 is None:
+            amax = np.abs(self.leaf_value).max(axis=1)
+            scale = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
+            q = np.clip(np.round(self.leaf_value / scale[:, None]),
+                        -127, 127).astype(np.int8)
+            self._int8 = (q, scale)
+        return self._int8
+
+    def dequantized_leaf_value(self) -> np.ndarray:
+        """[T, max_leaves] f32 leaf table of the int8 ensemble — the host
+        reference the int8 engine must score bit-equal against."""
+        q, scale = self.int8_tables()
+        return q.astype(np.float32) * scale[:, None]
+
+
+class ServingEngine:
+    """Compiled, batched prediction over one FlatEnsemble (see module
+    docstring).  Thread-compat with the repo's other device paths: one
+    engine per model, calls are serialized by the caller."""
+
+    def __init__(self, flat: FlatEnsemble,
+                 buckets: Sequence[int] = DEFAULT_BUCKETS,
+                 quantize: str = "float32", donate: str = "auto",
+                 algo: str = "bfs"):
+        if quantize not in ("float32", "int8"):
+            raise ValueError("quantize must be float32 or int8")
+        if algo not in ("bfs", "scan"):
+            raise ValueError("algo must be bfs or scan")
+        buckets = tuple(sorted({int(b) for b in buckets}))
+        if not buckets or buckets[0] < 1:
+            raise ValueError("buckets must be positive ints")
+        self.flat = flat
+        self.buckets = buckets
+        self.quantize = quantize
+        self.algo = algo
+        self.donate = self._resolve_donate(donate)
+        self._tables = None            # device-resident node tensors
+        self._programs: Dict[str, object] = {}
+
+    @staticmethod
+    def _resolve_donate(donate: str) -> bool:
+        if donate not in ("auto", "true", "false"):
+            raise ValueError("donate must be auto, true or false")
+        if donate == "auto":
+            # CPU ignores donation with a warning per call site — auto
+            # keeps serving logs clean there; accelerators donate
+            try:
+                import jax
+                return jax.default_backend() != "cpu"
+            except Exception:
+                return False
+        return donate == "true"
+
+    # ------------------------------------------------------------ programs
+
+    def _device_tables(self):
+        """Push the flattened tensors to device ONCE (cached jnp arrays;
+        re-used by every bucketed call — steady-state serving transfers
+        only the codes buffer)."""
+        if self._tables is None:
+            import jax.numpy as jnp
+            f = self.flat
+            t = {
+                "sf": jnp.asarray(f.split_feature),
+                "tr": jnp.asarray(f.threshold_rank),
+                "lc": jnp.asarray(f.left_child),
+                "rc": jnp.asarray(f.right_child),
+                "root": jnp.asarray(f.root_state),
+                "tc": jnp.asarray(f.tree_class),
+                "nl": jnp.asarray(f.num_leaves),
+            }
+            if self.quantize == "int8":
+                q, scale = f.int8_tables()
+                t["lv_q"] = jnp.asarray(q)
+                t["lv_scale"] = jnp.asarray(scale)
+                # the scan A/B path reads a plain f32 table: give it the
+                # DEQUANTIZED one so algo=scan scores the same quantized
+                # model bit-for-bit (never silently full precision)
+                t["lv"] = jnp.asarray(f.dequantized_leaf_value())
+            else:
+                t["lv"] = jnp.asarray(f.leaf_value)
+            self._tables = t
+        return self._tables
+
+    def _program(self, kind: str):
+        """One costmodel-instrumented jit per kind ("scores"/"leaves");
+        bucket shapes are signatures of the SAME program object, so the
+        compiled-program inventory stays a closed set (the no-recompile
+        assertion tests/test_serving.py pins via the compile counters)."""
+        prog = self._programs.get(kind)
+        if prog is None:
+            import jax
+
+            from .ops import scoring
+            donate = (0,) if self.donate else ()
+            if kind == "scores":
+                impl = (scoring.bfs_scores_int8_impl
+                        if self.quantize == "int8"
+                        else scoring.bfs_scores_impl)
+                fn = jax.jit(impl,
+                             static_argnames=("max_depth", "num_class"),
+                             donate_argnums=donate)
+            else:
+                fn = jax.jit(scoring.bfs_leaf_indices_impl,
+                             static_argnames=("max_depth",),
+                             donate_argnums=donate)
+            tag = "_int8" if (self.quantize == "int8"
+                              and kind == "scores") else ""
+            prog = costmodel.instrument(f"serve/bfs_{kind}{tag}", fn,
+                                        phase="predict")
+            self._programs[kind] = prog
+        return prog
+
+    def _run_scores(self, codes_chunk):
+        import jax.numpy as jnp
+        t = self._device_tables()
+        f = self.flat
+        if self.algo == "scan":
+            # legacy per-tree replay (the training-side scorer) at the
+            # engine's bucket shapes — the A/B reference bench_predict
+            # prices the breadth-first walk against.  t["lv"] is the
+            # device-cached f32 table (dequantized under quantize=int8),
+            # so the A/B pays no per-call upload and never silently
+            # serves full precision for an int8 engine.
+            from .ops.scoring import ensemble_scores
+            return ensemble_scores(
+                jnp.asarray(codes_chunk), t["sf"], t["tr"], t["lc"],
+                t["rc"], t["lv"], t["nl"], t["tc"],
+                max_nodes=f.max_nodes, num_class=f.num_class)
+        prog = self._program("scores")
+        if self.quantize == "int8":
+            return prog(jnp.asarray(codes_chunk), t["sf"], t["tr"],
+                        t["lc"], t["rc"], t["lv_q"], t["lv_scale"],
+                        t["root"], t["tc"], max_depth=f.max_depth,
+                        num_class=f.num_class)
+        return prog(jnp.asarray(codes_chunk), t["sf"], t["tr"], t["lc"],
+                    t["rc"], t["lv"], t["root"], t["tc"],
+                    max_depth=f.max_depth, num_class=f.num_class)
+
+    def _run_leaves(self, codes_chunk):
+        import jax.numpy as jnp
+        t = self._device_tables()
+        f = self.flat
+        if self.algo == "scan":
+            from .ops.scoring import ensemble_leaf_indices
+            return ensemble_leaf_indices(
+                jnp.asarray(codes_chunk), t["sf"], t["tr"], t["lc"],
+                t["rc"], t["nl"], max_nodes=f.max_nodes)
+        return self._program("leaves")(
+            jnp.asarray(codes_chunk), t["sf"], t["tr"], t["lc"], t["rc"],
+            t["root"], max_depth=f.max_depth)
+
+    # ------------------------------------------------------------- serving
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest bucket that holds ``n`` rows (callers chunk at the
+        largest bucket first, so n <= buckets[-1] here)."""
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.buckets[-1]
+
+    def _bucketed(self, features: np.ndarray, run, assemble):
+        """encode → chunk at the largest bucket → pad-to-bucket → run →
+        strip padding.  ``run`` maps a padded [F, B] codes chunk to a
+        device result; ``assemble`` concatenates the per-chunk np arrays
+        along the row axis."""
+        with telemetry.span("predict_encode"):
+            codes = self.flat.encode(features)
+        N = codes.shape[1]
+        maxb = self.buckets[-1]
+        outs = []
+        telemetry.count("serve/predict_calls")
+        telemetry.count("serve/rows", N)
+        with telemetry.span("predict") as sp:
+            for s in range(0, max(N, 1), maxb):
+                chunk = codes[:, s:s + maxb]
+                n = chunk.shape[1]
+                b = self.bucket_for(n)
+                if b > n:
+                    telemetry.count("serve/pad_rows", b - n)
+                    chunk = np.concatenate(
+                        [chunk, np.zeros((chunk.shape[0], b - n),
+                                         chunk.dtype)], axis=1)
+                telemetry.count(f"serve/bucket_{b}")
+                # fence like every device-work span (PR 4): unfenced
+                # async spans time the dispatch, not the walk, and the
+                # predict-phase roofline would be meaningless
+                outs.append((sp.fence(run(chunk)), n))
+        return assemble(outs)
+
+    def scores(self, features: np.ndarray) -> np.ndarray:
+        """[num_class, N] raw ensemble score sums (float64 on host, f32
+        accumulation on device — identical to the training-side scorer's
+        accumulation order)."""
+        if self.flat.num_trees == 0:
+            return np.zeros((self.flat.num_class, features.shape[0]))
+        return self._bucketed(
+            features, self._run_scores,
+            lambda outs: np.concatenate(
+                [np.asarray(o, np.float64)[:, :n] for o, n in outs],
+                axis=1))
+
+    def leaf_indices(self, features: np.ndarray) -> np.ndarray:
+        """[N, T] leaf index per tree (PredictLeafIndex layout)."""
+        if self.flat.num_trees == 0:
+            return np.zeros((features.shape[0], 0), np.int32)
+        return self._bucketed(
+            features, self._run_leaves,
+            lambda outs: np.concatenate(
+                [np.asarray(o, np.int32)[:, :n].T for o, n in outs],
+                axis=0))
+
+
+def engine_options_from_config(io_config) -> dict:
+    """The IOConfig → ServingEngine option mapping, single-homed (cli.py
+    and Predictor both consult it)."""
+    return {
+        "buckets": io_config.predict_bucket_list(),
+        "quantize": io_config.predict_quantize,
+        "donate": io_config.predict_donate,
+        "algo": io_config.predict_algo,
+    }

@@ -972,6 +972,17 @@ def span(name: str):
 # kernels".  Pipelined boosting deliberately adds NO counters: it changes
 # host wait order only, and the phase spans (model_readback migrating off
 # the critical path) are the observable.
+#
+# Serving counters (ISSUE 7, lightgbm_tpu/serving.py):
+# ``serve/ensemble_flatten`` = once per FlatEnsemble build (the
+# encode-once contract: predict_file must read 1 for the whole file);
+# ``serve/predict_calls`` / ``serve/rows`` / ``serve/pad_rows`` = engine
+# call volume and the pad overhead the bucket ladder costs;
+# ``serve/bucket_<B>`` = which compiled batch shape served each call.
+# The engine's device programs are costmodel-instrumented under phase
+# "predict" (span of the same name wraps the device walk;
+# "predict_encode" times the host rank-encode), so the roofline and
+# compile blocks attribute serving alongside training.
 
 def count(name: str, n: int = 1) -> None:
     """Bump a monotonic counter (kernel-route decisions, env-var trips,

@@ -63,6 +63,15 @@ RATE_KEYS: Tuple[Tuple[str, str], ...] = (
     # per-class histogram schedule even if the headline's auto
     # resolution ever changes
     ("mixedbin_iters_per_sec", "mixedbin_spread"),
+    # serving lanes (ISSUE 7, bench.py --bench-predict): predictions/sec
+    # off the compiled serving engine at the gated bucket shapes — the
+    # 64k throughput bucket (f32 and int8 ensembles) and the 1k
+    # latency-tier bucket.  Latency percentiles (p50/p99) and the
+    # bfs-vs-scan A/B ratio ride in the record ungated (lower-is-better
+    # keys don't fit the drop-gate; the ratio is informational).
+    ("predict_b65536_rows_per_sec", "predict_b65536_spread"),
+    ("predict_int8_b65536_rows_per_sec", "predict_int8_b65536_spread"),
+    ("predict_b1024_rows_per_sec", "predict_b1024_spread"),
 )
 
 DEFAULT_FLOOR = 0.02      # minimum relative noise band when none recorded
@@ -183,6 +192,19 @@ def _check_group(metric: str, entries: List[dict], floor: float,
             f"{metric}: trajectory mixes device kinds {sorted(kinds)} — "
             "cross-hardware comparisons refused "
             "(--allow-cross-hardware to override)")
+    # serving no-recompile contract (ISSUE 7): a nonzero
+    # predict_recompiles means the bucket ladder stopped being a closed
+    # program set — an absolute red flag, no trajectory needed
+    recompiles = entries[-1]["rec"].get("predict_recompiles")
+    if isinstance(recompiles, (int, float)) and recompiles > 0:
+        findings.append({
+            "metric": metric, "key": "predict_recompiles",
+            "latest_round": entries[-1]["round"],
+            "latest": recompiles, "baseline": 0,
+            "detail": "serving engine recompiled at a bucketed batch "
+                      "shape (the compiled-program ladder is no longer "
+                      "closed)",
+        })
     if len(entries) < 2:
         return
     latest_round = entries[-1]["round"]

@@ -94,6 +94,21 @@ def test_satellite_keys_checked(tmp_path):
     assert keys == ["parity_leafwise_f32_iters_per_sec"]
 
 
+def test_serving_recompiles_flagged_absolutely(tmp_path):
+    """predict_recompiles > 0 in the latest round is an absolute red
+    flag (the bucket ladder stopped being closed) — no trajectory or
+    noise band applies, and zero passes clean."""
+    paths = _history(tmp_path, [1.67, 1.67, 1.67],
+                     extra={"predict_recompiles": 0})
+    report = perf_gate.check_files(paths)
+    assert not report["findings"]
+    paths.append(_write_round(tmp_path, 4, 1.67,
+                              extra={"predict_recompiles": 2}))
+    report = perf_gate.check_files(paths)
+    assert any(f["key"] == "predict_recompiles" and f["latest"] == 2
+               for f in report["findings"])
+
+
 def test_metric_groups_are_not_cross_compared(tmp_path):
     """A 1M round followed by 11M rounds (the real r01->r02 shape): the
     scale change must not read as an 80% regression."""

@@ -1,0 +1,297 @@
+"""Compiled serving engine (ISSUE 7, lightgbm_tpu/serving.py).
+
+Correctness bar: the breadth-first lockstep engine scores BIT-EQUAL to
+the training-side scorer (ops/scoring.ensemble_scores — the engine's
+algo="scan" path drives the identical kernels) on every objective, leaf
+indices match the host replay exactly, bucket padding never leaks into
+results, and steady-state bucketed calls keep a CLOSED compiled-program
+inventory (zero recompiles, pinned via the costmodel registry).
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import costmodel, serving, telemetry
+from lightgbm_tpu.io.dataset import Dataset
+from lightgbm_tpu.models.predictor import Predictor
+from lightgbm_tpu.models.tree import Tree
+from lightgbm_tpu.ops.scoring import ensemble_scores
+from lightgbm_tpu.serving import FlatEnsemble, ServingEngine
+
+BASE = {"num_leaves": 15, "min_data_in_leaf": 20,
+        "min_sum_hessian_in_leaf": 1.0, "num_iterations": 8,
+        "learning_rate": 0.2}
+
+OBJECTIVES = ("regression", "binary", "lambdarank", "multiclass")
+
+
+def _case(objective, n=500, f=6, seed=3):
+    """(trained booster, features) for one objective."""
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, f)
+    params = dict(BASE, objective=objective)
+    ds_kwargs = {}
+    if objective == "regression":
+        y = (x[:, 0] + 0.3 * x[:, 1] ** 2
+             + 0.1 * rng.randn(n)).astype(np.float32)
+    elif objective == "binary":
+        y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(np.float32)
+    elif objective == "lambdarank":
+        y = np.clip(np.digitize(x[:, 0], [-0.6, 0.2, 1.0]),
+                    0, 3).astype(np.float32)
+        ds_kwargs["query_boundaries"] = np.arange(0, n + 1, 50)
+    else:
+        y = np.digitize(x[:, 0], [-0.5, 0.5]).astype(np.float32)
+        params["num_class"] = 3
+        params["num_iterations"] = 4   # 4 iters x 3 class trees
+    ds = Dataset.from_arrays(x, y, max_bin=64, **ds_kwargs)
+    return lgb.train(params, ds), x
+
+
+def _host_scores(flat, leaf_value, features):
+    """Sequential f32 per-class accumulation from a host replay of the
+    flattened model — the engine's exact accumulation order."""
+    codes = flat.encode(features)
+    N = features.shape[0]
+    score = np.zeros((flat.num_class, N), np.float32)
+    for t in range(flat.num_trees):
+        # replay the BFS walk per tree on host
+        states = np.full(N, int(flat.root_state[t]), np.int32)
+        for _ in range(max(flat.max_depth, 1)):
+            node = np.maximum(states, 0)
+            sf = flat.split_feature[t][node]
+            go_right = codes[sf, np.arange(N)] > flat.threshold_rank[t][node]
+            nxt = np.where(go_right, flat.right_child[t][node],
+                           flat.left_child[t][node])
+            states = np.where(states >= 0, nxt, states)
+        leaf = -states - 1
+        score[flat.tree_class[t]] += leaf_value[t][leaf]
+    return score
+
+
+@pytest.mark.parametrize("objective", OBJECTIVES)
+def test_bit_equal_vs_training_scorer(objective):
+    """f32 engine scores == the training-side per-tree scan scorer,
+    bitwise, on every objective (and close to the f64 host tree walk)."""
+    booster, x = _case(objective)
+    flat = booster.export_flat()
+    bfs = ServingEngine(flat).scores(x)
+    scan = ServingEngine(flat, algo="scan").scores(x)
+    np.testing.assert_array_equal(bfs, scan)
+    # sanity vs the f64 host walk: same leaves, f32 accumulation only
+    host = np.zeros((booster.num_class, x.shape[0]))
+    for k, t in enumerate(booster.models):
+        host[k % booster.num_class] += t.predict(x)
+    np.testing.assert_allclose(bfs, host, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("objective", OBJECTIVES)
+def test_int8_engine_bit_equal_to_dequantized_replay(objective):
+    """int8 engine == host replay of the SAME quantized leaf table,
+    bitwise (routing is untouched by quantization), and within the
+    per-tree quantization-step bound of the f32 scores."""
+    booster, x = _case(objective)
+    flat = booster.export_flat()
+    eng8 = ServingEngine(flat, quantize="int8")
+    s8 = eng8.scores(x)
+    expected = _host_scores(flat, flat.dequantized_leaf_value(), x)
+    np.testing.assert_array_equal(s8, expected.astype(np.float64))
+    s32 = ServingEngine(flat).scores(x)
+    _, scale = flat.int8_tables()
+    # each tree rounds by at most scale/2
+    assert np.abs(s8 - s32).max() <= scale.sum() / 2 + 1e-6
+    # the scan A/B path must score the SAME quantized model (it serves
+    # the dequantized table, never silently full precision)
+    s8_scan = ServingEngine(flat, quantize="int8", algo="scan").scores(x)
+    np.testing.assert_array_equal(s8, s8_scan)
+
+
+def test_bucket_padding_correctness():
+    """Pad-to-bucket must never leak into results: every batch size maps
+    to the exact-shape reference (the training scorer run UNPADDED)."""
+    booster, x = _case("binary", n=1200)
+    flat = booster.export_flat()
+    eng = ServingEngine(flat, buckets=(1, 32, 1024, 65536))
+    import jax.numpy as jnp
+    for n in (1, 31, 33, 1000):
+        got = eng.scores(x[:n])
+        codes = flat.encode(x[:n])
+        exact = ensemble_scores(
+            jnp.asarray(codes), jnp.asarray(flat.split_feature),
+            jnp.asarray(flat.threshold_rank), jnp.asarray(flat.left_child),
+            jnp.asarray(flat.right_child), jnp.asarray(flat.leaf_value),
+            jnp.asarray(flat.num_leaves), jnp.asarray(flat.tree_class),
+            max_nodes=flat.max_nodes, num_class=flat.num_class)
+        np.testing.assert_array_equal(got, np.asarray(exact, np.float64))
+
+
+def test_chunking_beyond_largest_bucket():
+    """N above the biggest bucket chunks internally and still matches."""
+    booster, x = _case("binary", n=700)
+    flat = booster.export_flat()
+    small = ServingEngine(flat, buckets=(1, 256))
+    big = ServingEngine(flat, buckets=(1024,))
+    np.testing.assert_array_equal(small.scores(x), big.scores(x))
+
+
+@pytest.mark.parametrize("quantize", ["float32", "int8"])
+def test_leaf_index_parity(quantize):
+    """Engine leaf indices == the host replay walk, exactly — in both
+    ensemble modes (quantization never touches routing)."""
+    booster, x = _case("binary")
+    eng = ServingEngine(booster.export_flat(), quantize=quantize)
+    host = booster.predict_leaf_index(x)   # host path (below threshold)
+    np.testing.assert_array_equal(eng.leaf_indices(x), host)
+
+
+def test_nan_routes_left_through_engine():
+    booster, x = _case("binary")
+    xe = x[:64].copy()
+    xe[:, :3] = np.nan
+    host = np.zeros(64)
+    for t in booster.models:
+        host += t.predict(xe)
+    got = ServingEngine(booster.export_flat()).scores(xe)[0]
+    np.testing.assert_allclose(got, host, rtol=1e-5, atol=1e-6)
+
+
+def test_stump_trees_supported():
+    """num_leaves==1 trees (degenerate stops) flatten to a ~0 root state
+    and contribute their constant leaf everywhere."""
+    stump = Tree(1, *[np.zeros(0)] * 8, leaf_value=np.array([0.25]))
+    booster, x = _case("binary", n=200)
+    models = [stump] + booster.models
+    flat = FlatEnsemble.from_models(models, 1)
+    got = ServingEngine(flat).scores(x)[0]
+    base = ServingEngine(booster.export_flat()).scores(x)[0]
+    # the stump's constant enters the f32 accumulation FIRST on device
+    # (tree order), while `base + 0.25` adds it last in f64 — identical
+    # leaves, rounding-order-only difference
+    np.testing.assert_allclose(got, base + np.float32(0.25),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_no_recompile_on_repeated_bucketed_calls():
+    """Steady-state contract: repeated calls across batch sizes within
+    the bucket ladder bump call counts on EXISTING compiled programs and
+    never add a new signature (costmodel registry — the compile
+    counters)."""
+    booster, x = _case("binary")
+    telemetry.enable()
+    telemetry.reset()
+    try:
+        eng = ServingEngine(booster.export_flat(), buckets=(1, 32, 1024))
+        for n in (5, 9, 31):          # all land in the 32 bucket
+            eng.scores(x[:n])
+        progs = costmodel.phase_program_records("predict")
+        n_programs = len(progs)
+        assert n_programs >= 1
+        calls0 = sum(r["calls"] for r in progs)
+        for n in (6, 17, 32, 2, 30):  # same bucket, five more calls
+            eng.scores(x[:n])
+        progs = costmodel.phase_program_records("predict")
+        assert len(progs) == n_programs, \
+            "bucketed repeat calls added a compiled program (recompile)"
+        assert sum(r["calls"] for r in progs) == calls0 + 5
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+
+
+def test_donation_smoke():
+    """Forced donation stays correct across repeated calls (the donated
+    codes buffer is rebuilt per call; CPU ignores donation with a
+    warning — the contract is correctness, not the recycle)."""
+    booster, x = _case("binary")
+    flat = booster.export_flat()
+    base = ServingEngine(flat, donate="false").scores(x[:40])
+    eng = ServingEngine(flat, donate="true")
+    for _ in range(2):
+        np.testing.assert_array_equal(eng.scores(x[:40]), base)
+
+
+def test_predict_file_flattens_ensemble_once(tmp_path):
+    """predict_file's chunk loop must NOT re-encode the ensemble per
+    chunk: one flatten for the whole file (the old per-call
+    _device_predict_encode re-ran it every 500k lines)."""
+    booster, x = _case("binary", n=200)
+    data = tmp_path / "pred.tsv"
+    np.savetxt(data, np.column_stack([np.zeros(len(x)), x]),
+               delimiter="\t", fmt="%.8f")
+    base_count = serving.FLATTEN_COUNT
+    predictor = Predictor(booster, True, False, -1)
+    predictor.predict_file(str(data), str(tmp_path / "out.txt"),
+                           has_header=False, chunk_lines=40)  # 5 chunks
+    assert serving.FLATTEN_COUNT == base_count + 1
+    preds = np.loadtxt(tmp_path / "out.txt")
+    assert preds.shape == (200,)
+    assert np.all((preds >= 0) & (preds <= 1))
+    # the file path agrees with the in-memory engine path (6-decimal
+    # text round-trip)
+    expected = predictor.predict_matrix(x)
+    np.testing.assert_allclose(preds, expected, atol=5e-7)
+
+
+def test_predict_matrix_pads_in_input_dtype():
+    """The short-row pad must use the INPUT dtype — np.zeros' f64
+    default silently upcast f32 matrices on concatenate."""
+    booster, x = _case("binary")
+    predictor = Predictor(booster, True, False, -1)
+    seen = {}
+    orig = predictor.engine.scores
+
+    def spy(features):
+        seen["dtype"] = features.dtype
+        return orig(features)
+
+    predictor.engine.scores = spy
+    predictor.predict_matrix(x[:, :-1].astype(np.float32))
+    assert seen["dtype"] == np.float32
+
+
+def test_predictor_modes_match_gbdt():
+    """Predictor transforms (sigmoid / softmax / leaf index) equal the
+    GBDT host-path predictions."""
+    booster, x = _case("binary")
+    p = Predictor(booster, True, False, -1)
+    np.testing.assert_allclose(p.predict_matrix(x), booster.predict(x),
+                               rtol=1e-5, atol=1e-6)
+    p_leaf = Predictor(booster, True, True, -1)
+    np.testing.assert_array_equal(p_leaf.predict_matrix(x),
+                                  booster.predict_leaf_index(x))
+    mbooster, mx = _case("multiclass")
+    mp = Predictor(mbooster, True, False, -1)
+    np.testing.assert_allclose(mp.predict_matrix(mx),
+                               mbooster.predict_multiclass(mx),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_gbdt_engine_cache_invalidates_on_new_trees():
+    """serving_engine caches across calls but re-flattens once the model
+    grows (continued training must not serve stale trees)."""
+    booster, x = _case("binary")
+    e1 = booster.serving_engine()
+    assert booster.serving_engine() is e1
+    booster.train_one_iter(is_eval=False)
+    e2 = booster.serving_engine()
+    assert e2 is not e1
+    assert e2.flat.num_trees == e1.flat.num_trees + 1
+
+
+def test_serving_config_options():
+    from lightgbm_tpu.config import OverallConfig
+    from lightgbm_tpu.serving import engine_options_from_config
+    from lightgbm_tpu.utils.log import LightGBMError
+    cfg = OverallConfig()
+    cfg.set({"predict_buckets": "64,8", "predict_quantize": "int8",
+             "predict_algo": "scan", "predict_donate": "false"},
+            require_data=False)
+    assert cfg.io_config.predict_bucket_list() == (8, 64)
+    opts = engine_options_from_config(cfg.io_config)
+    assert opts == {"buckets": (8, 64), "quantize": "int8",
+                    "donate": "false", "algo": "scan"}
+    for bad in ({"predict_quantize": "int4"}, {"predict_algo": "dfs"},
+                {"predict_donate": "maybe"}, {"predict_buckets": "0,4"},
+                {"predict_buckets": "a,b"}):
+        with pytest.raises(LightGBMError):
+            OverallConfig().set(dict(bad), require_data=False)
