@@ -26,6 +26,22 @@ codes buffer; ``auto`` = accelerators only) and ``predict_algo``
 for A/B).  All four are score transforms of the SAME model — only
 ``predict_quantize=int8`` changes values, by the documented quantization
 step.
+
+Streaming ingestion & on-device sampling knobs (ISSUE 8 —
+lightgbm_tpu/io/streaming.py + ops/sampling.py): ``streaming``
+(``auto`` engages the chunked parse→bin→HBM loader for files ≥256 MB;
+``true``/``false`` force — datasets/models are bit-identical either
+way), ``ingest_chunk_rows`` (the parse/bin/transfer chunk length, and
+the bound on host-resident raw rows; default 200k),
+``bagging_device`` (``auto`` draws bagging masks on-device on
+accelerator backends — a redraw becomes a threefry key bump instead of
+a host full-N draw + upload; the RNG STREAM differs from the host
+path, so trees differ by the sampling draw only;
+``LGBM_TPU_HOST_BAGGING=1`` is the A/B hatch) and ``goss`` +
+``top_rate``/``other_rate`` (gradient-based one-side sampling, run
+entirely on device; incompatible with bagging and multi-process
+training).  ``streaming``/``ingest_chunk_rows``/``bagging_device`` are
+model-invariant; ``goss`` changes the trained model by design.
 """
 from __future__ import annotations
 
@@ -145,9 +161,25 @@ class Application:
             rank = get_rank()
             shard_count = _jax.process_count()
             bin_finder = distributed_bin_finder(self.config)
+        # single-process parallel consumers take the streamed bin matrix
+        # committed on the LEARNER's device mesh (explicit NamedSharding
+        # placement; parallel.mesh.dataset_row_sharding): row-sharded
+        # over the (data,) axis for tree_learner=data when the row count
+        # divides the mesh, replicated on that mesh otherwise (a
+        # multi-device shard_map rejects a one-device commit) — resident
+        # loads and serial training are unaffected
+        single_proc_parallel = (self.config.is_parallel
+                                and shard_count == 1)
+        shard_rows = (single_proc_parallel
+                      and self.config.boosting_config.tree_learner
+                      == "data")
         self.train_data = Dataset.load_train(
             self.config.io_config, rank=rank, num_machines=shard_count,
-            predict_fun=predict_fun, bin_finder=bin_finder)
+            predict_fun=predict_fun, bin_finder=bin_finder,
+            shard_rows=shard_rows,
+            shard_devices=(self.config.network_config.num_machines
+                           if single_proc_parallel else None),
+            device_type=self.config.device_type)
 
         self.train_metrics = []
         if self.config.boosting_config.is_provide_training_metric:

@@ -193,6 +193,17 @@ class IOConfig:
     predict_algo: str = "bfs"
     is_pre_partition: bool = False
     is_enable_sparse: bool = True
+    # Streaming ingestion (ISSUE 8, lightgbm_tpu/io/streaming.py):
+    # chunked parse→sample→bin with double-buffered host→device feeds —
+    # bit-identical datasets/models to the resident loader, host memory
+    # bounded by one chunk instead of the full unbinned matrix.  "auto"
+    # (default) engages when the data (or cache) file is at least
+    # streaming.AUTO_MIN_BYTES (256 MB); "true"/"false" force.
+    # Supersedes use_two_round_loading when both apply.
+    streaming: str = "auto"
+    # parse/bin/transfer chunk length (rows) of the streaming loader —
+    # also the bound on how many raw rows are ever host-resident
+    ingest_chunk_rows: int = 200_000
     use_two_round_loading: bool = False
     is_save_binary_file: bool = False
     # format of the is_save_binary_file cache: "native" (pickle header +
@@ -292,6 +303,15 @@ class IOConfig:
             self.predict_algo = value
         self.is_pre_partition = _get_bool(params, "is_pre_partition", self.is_pre_partition)
         self.is_enable_sparse = _get_bool(params, "is_enable_sparse", self.is_enable_sparse)
+        if "streaming" in params:
+            value = params["streaming"].lower()
+            log.check(value in ("auto", "true", "false"),
+                      "streaming must be auto, true or false")
+            self.streaming = value
+        self.ingest_chunk_rows = _get_int(params, "ingest_chunk_rows",
+                                          self.ingest_chunk_rows)
+        log.check(self.ingest_chunk_rows > 0,
+                  "ingest_chunk_rows should be > 0")
         self.use_two_round_loading = _get_bool(params, "use_two_round_loading",
                                                self.use_two_round_loading)
         self.is_save_binary_file = _get_bool(params, "is_save_binary_file",
@@ -543,6 +563,29 @@ class BoostingConfig:
     # unless they opt in explicitly); multi-process runs stay off.
     # LGBM_TPU_PIPELINE overrides for A/B timing.
     pipeline: str = "auto"
+    # Device-side bagging (ISSUE 8, lightgbm_tpu/ops/sampling.py): draw
+    # the in-bag mask on-device (one threefry key per redraw) instead of
+    # a host numpy draw plus a full-N mask upload every bagging_freq
+    # iterations.  Exact in-bag count like the host path; the RNG STREAM
+    # differs (threefry vs MT19937), so trained trees differ from the
+    # host path by the sampling draw only.  "auto" = on for accelerator
+    # backends in single-process, no-query runs; "true"/"false" force
+    # (true still falls back — with a warning — where the device draw
+    # cannot apply: multi-process shards, per-query bagging).
+    # LGBM_TPU_HOST_BAGGING=1 is the env A/B hatch back to the host path.
+    bagging_device: str = "auto"
+    # GOSS — gradient-based one-side sampling (ISSUE 8; the headline
+    # trick of the later LightGBM paper): each iteration keeps the
+    # top_rate fraction of rows by gradient magnitude plus an other_rate
+    # fraction of the remainder sampled uniformly, amplifying the
+    # sampled remainder's gradients AND hessians by
+    # (1-top_rate)/other_rate.  The selection runs entirely on device
+    # and feeds the histogram kernels through the row-mask seam.
+    # Incompatible with bagging (the reference family's rule) and with
+    # multi-process training in this revision.
+    goss: bool = False
+    top_rate: float = 0.2
+    other_rate: float = 0.1
     tree_config: TreeConfig = dataclasses.field(default_factory=TreeConfig)
 
     def set(self, params: Dict[str, str]) -> None:
@@ -584,6 +627,24 @@ class BoostingConfig:
             log.check(value in ("auto", "off", "readback"),
                       "pipeline must be auto, off or readback")
             self.pipeline = value
+        if "bagging_device" in params:
+            value = params["bagging_device"].lower()
+            log.check(value in ("auto", "true", "false"),
+                      "bagging_device must be auto, true or false")
+            self.bagging_device = value
+        self.goss = _get_bool(params, "goss", self.goss)
+        self.top_rate = _get_float(params, "top_rate", self.top_rate)
+        self.other_rate = _get_float(params, "other_rate", self.other_rate)
+        if self.goss:
+            log.check(0.0 <= self.top_rate < 1.0,
+                      "top_rate should be in [0, 1)")
+            log.check(0.0 < self.other_rate <= 1.0,
+                      "other_rate should be in (0, 1]")
+            log.check(self.top_rate + self.other_rate <= 1.0,
+                      "top_rate + other_rate should be <= 1")
+            if self.bagging_fraction < 1.0 and self.bagging_freq > 0:
+                log.fatal("Cannot use bagging in GOSS mode "
+                          "(goss=true with bagging_fraction < 1)")
         if "tree_learner" in params:
             value = params["tree_learner"].lower()
             if value == "serial":

@@ -57,6 +57,11 @@ class Dataset:
     def __init__(self):
         self.data_filename: str = ""
         self.bins: Optional[np.ndarray] = None
+        # streaming ingestion (io/streaming.py): single-process streamed
+        # loads land the bin matrix directly in device memory (a
+        # jax.Array with explicit NamedSharding placement); ``bins``
+        # stays None then — the host never holds the full matrix
+        self.device_bins = None
         self.bin_mappers: List[BinMapper] = []
         self.num_bins: np.ndarray = np.zeros(0, dtype=np.int32)
         self.real_feature_idx: np.ndarray = np.zeros(0, dtype=np.int32)
@@ -75,13 +80,28 @@ class Dataset:
     @classmethod
     def load_train(cls, io_config, rank: int = 0, num_machines: int = 1,
                    predict_fun: Optional[Callable] = None,
-                   bin_finder: Optional[Callable] = None) -> "Dataset":
+                   bin_finder: Optional[Callable] = None,
+                   shard_rows: bool = False,
+                   shard_devices: Optional[int] = None,
+                   device_type: str = "") -> "Dataset":
         """LoadTrainData (dataset.cpp:420-465).
 
         ``bin_finder(sample_matrix, max_bin) -> List[BinMapper]`` lets the
         distributed path plug in feature-sliced bin finding + allgather
         (dataset.cpp:353-415); default is local bin finding.
+
+        ``shard_rows``: a single-process data-parallel learner will
+        consume the dataset — a streamed load then places the device
+        matrix row-sharded over the ``(data,)`` mesh axis
+        (parallel.mesh.dataset_row_sharding) instead of replicated.
+        ``shard_devices`` (with ``device_type``): set for ANY
+        single-process parallel consumer to the learner's mesh size —
+        the streamed matrix is then committed on the learner's exact
+        device mesh (row-sharded under ``shard_rows`` when rows divide
+        it, replicated on that mesh otherwise), never on the serial
+        one-device placement a multi-device shard_map would reject.
         """
+        from . import streaming
         self = cls()
         self.data_filename = io_config.data_filename
         self.max_bin = io_config.max_bin
@@ -91,10 +111,20 @@ class Dataset:
         if os.path.exists(bin_path):
             kind = self._classify_binary_cache(bin_path)
             if kind == "ours":
-                log.info("Loading data set from binary file")
-                self._load_binary(bin_path, rank, num_machines,
-                                  io_config.is_pre_partition,
-                                  io_config.data_random_seed)
+                if (num_machines <= 1 and streaming.single_process()
+                        and streaming.resolve_streaming(io_config,
+                                                        bin_path)):
+                    log.info("Loading data set from binary file "
+                             "(streamed)")
+                    streaming.load_binary_streaming(
+                        self, bin_path, io_config, shard_rows=shard_rows,
+                        shard_devices=shard_devices,
+                        device_type=device_type)
+                else:
+                    log.info("Loading data set from binary file")
+                    self._load_binary(bin_path, rank, num_machines,
+                                      io_config.is_pre_partition,
+                                      io_config.data_random_seed)
                 self._attach_init_score(io_config.input_init_score,
                                         predict_fun)
                 return self
@@ -150,6 +180,22 @@ class Dataset:
 
         parser = parser_mod.create_parser(io_config.data_filename,
                                           io_config.has_header, 0, label_idx)
+        if streaming.resolve_streaming(io_config, io_config.data_filename):
+            # streaming ingestion (ISSUE 8, io/streaming.py): chunked
+            # parse→sample→bin with double-buffered device feeds —
+            # bit-identical to the resident load below, and strictly
+            # more memory-bound than two-round loading (which it
+            # supersedes when both are requested)
+            if io_config.use_two_round_loading:
+                log.info("streaming supersedes use_two_round_loading")
+            streaming.load_train_streaming(
+                self, io_config, parser, rank, num_machines, predict_fun,
+                bin_finder, weight_idx, group_idx, ignore_set,
+                header_names, shard_rows=shard_rows,
+                shard_devices=shard_devices, device_type=device_type,
+                foreign_bin=foreign_bin)
+            self.metadata.finalize(self.num_data)
+            return self
         if io_config.use_two_round_loading:
             # streaming two-pass load (dataset.cpp two-round path): never
             # materializes the [N, F] float64 matrix — pass 1 samples rows
@@ -581,10 +627,12 @@ class Dataset:
 
     # ---------------------------------------------------------- binary cache
 
-    def save_binary(self, path: str) -> None:
-        """Binary dataset cache (dataset.cpp:653-713).  Own format: magic +
-        pickled header + raw bin matrix."""
-        header = {
+    def _binary_header(self, bins_dtype, bins_shape) -> dict:
+        """The native binary cache's pickled header — shared by the
+        resident ``save_binary`` and the streaming loader's pass-2 memmap
+        cache writer (io/streaming._CacheWriter), so both produce
+        byte-identical files."""
+        return {
             "num_data": self.num_data,
             "global_num_data": self.global_num_data,
             "num_total_features": self.num_total_features,
@@ -593,12 +641,21 @@ class Dataset:
             "used_feature_map": self.used_feature_map,
             "max_bin": self.max_bin,
             "mappers": [m.to_bytes() for m in self.bin_mappers],
-            "bins_dtype": str(self.bins.dtype),
-            "bins_shape": self.bins.shape,
+            "bins_dtype": str(np.dtype(bins_dtype)),
+            "bins_shape": tuple(bins_shape),
             "label": self.metadata.label,
             "weights": self.metadata.weights,
             "query_boundaries": self.metadata.query_boundaries,
         }
+
+    def save_binary(self, path: str) -> None:
+        """Binary dataset cache (dataset.cpp:653-713).  Own format: magic +
+        pickled header + raw bin matrix."""
+        log.check(self.bins is not None,
+                  "save_binary needs a host-resident bin matrix (a "
+                  "streamed dataset writes its cache during ingestion — "
+                  "set is_save_binary_file at load time)")
+        header = self._binary_header(self.bins.dtype, self.bins.shape)
         # atomic write (temp + rename): a crash mid-save must not leave a
         # partial cache that a later run would misparse
         tmp = path + ".%d.tmp" % os.getpid()
@@ -735,6 +792,15 @@ class Dataset:
         except Exception as e:
             log.fatal("Binary file %s is a damaged lightgbm_tpu cache "
                       "(%s) — delete it to regenerate" % (path, e))
+        self._apply_binary_header(header)
+        self.bins = bins.reshape(header["bins_shape"]).copy()
+        self._reshard_rows(rank, num_machines, is_pre_partition,
+                           data_random_seed)
+        self.metadata.finalize(self.num_data)
+
+    def _apply_binary_header(self, header: dict) -> None:
+        """Install every non-bin field of a native cache header — shared
+        by the resident loader and the streaming (memmap) cache loader."""
         self.num_data = header["num_data"]
         self.global_num_data = header["global_num_data"]
         self.num_total_features = header["num_total_features"]
@@ -747,7 +813,6 @@ class Dataset:
                                          dtype=np.int32)
         self.num_bins = np.array([m.num_bin for m in self.bin_mappers],
                                  dtype=np.int32)
-        self.bins = bins.reshape(header["bins_shape"]).copy()
         self.metadata.set_label(header["label"])
         self.metadata.weights = header["weights"]
         self.metadata.query_boundaries = header["query_boundaries"]
@@ -756,9 +821,6 @@ class Dataset:
             # same recompute as the reference-cache loader: finalize()
             # only derives query weights on the queries-column path
             self.metadata._load_query_weights()
-        self._reshard_rows(rank, num_machines, is_pre_partition,
-                           data_random_seed)
-        self.metadata.finalize(self.num_data)
 
     def _reshard_rows(self, rank: int, num_machines: int,
                       is_pre_partition: bool, data_random_seed: int) -> None:

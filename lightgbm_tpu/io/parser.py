@@ -403,12 +403,32 @@ def prefetch_chunks(iterable, depth: int = 2):
 
 def read_lines(filename: str, skip_header: bool = False) -> List[str]:
     """Read all data lines (TextReader::ReadAllLines equivalent,
-    utils/text_reader.h:20-308 — pipelined IO replaced by buffered reads)."""
-    with open(filename, "r") as f:
-        lines = f.read().splitlines()
-    if skip_header and lines:
-        lines = lines[1:]
-    return [ln for ln in lines if ln]
+    utils/text_reader.h:20-308 — pipelined IO replaced by buffered reads).
+
+    Implemented ON TOP of ``read_line_chunks`` so the resident and
+    streaming loaders provably parse the SAME row set: the two readers
+    used to split and skip headers independently (``str.splitlines``
+    additionally breaks rows on \\f/\\v/\\u2028-class boundaries that
+    file iteration does not, and it dropped the first SPLIT line as the
+    header where the chunk reader consumes the first PHYSICAL line), so
+    a file could stream to a different dataset than it loaded resident.
+    One implementation, one semantics (tests/test_streaming.py pins
+    blank-line/header/exotic-separator cases)."""
+    out: List[str] = []
+    for chunk in read_line_chunks(filename, skip_header=skip_header):
+        out.extend(chunk)
+    return out
+
+
+def count_data_rows(filename: str, skip_header: bool = False) -> int:
+    """Count the data rows ``read_line_chunks`` would yield, without
+    parsing (streaming pass 0: the pinned-index binning sample needs the
+    total row count before any chunk is parsed).  Delegates to the chunk
+    reader itself — host memory stays bounded by one chunk of line
+    strings, and any future change to its header/blank-line filter keeps
+    pass 0 and pass 1/2 counting the same rows."""
+    return sum(len(chunk) for chunk in
+               read_line_chunks(filename, skip_header=skip_header))
 
 
 def read_line_chunks(filename: str, skip_header: bool = False,

@@ -227,7 +227,33 @@ class GBDT:
             # process passes identical (replicated) values into the
             # global-mesh chunk program
             _arr0 = np.asarray if self._mp_fp else jnp.asarray
-            self.bins_device = _arr0(self._bins_host(train_data))
+            dev_bins = getattr(train_data, "device_bins", None)
+            if dev_bins is not None and not self._host_inputs:
+                # streamed dataset (io/streaming.py): the bin matrix is
+                # already device-resident with explicit NamedSharding
+                # placement — no host copy exists to upload.  Mixed-bin
+                # packing reorders by one device-side gather.
+                if self._pack_spec is not None:
+                    self.bins_device = jnp.take(
+                        dev_bins,
+                        jnp.asarray(np.asarray(self._pack_spec.perm,
+                                               np.int32)), axis=0)
+                    # release the unpacked original: keeping both would
+                    # DOUBLE peak HBM for the whole run at the 100M-row
+                    # scale streaming exists for (the resident path's
+                    # duplicate lives on host).  The dataset is consumed
+                    # — a second init must re-stream (loud error below).
+                    train_data.device_bins = None
+                    train_data.device_bins_consumed = True
+                else:
+                    self.bins_device = dev_bins
+            else:
+                log.check(
+                    not getattr(train_data, "device_bins_consumed", False),
+                    "this streamed dataset's device bin matrix was "
+                    "consumed by a previous mixed-bin GBDT.init — reload "
+                    "the dataset to train another booster on it")
+                self.bins_device = _arr0(self._bins_host(train_data))
             self.num_bins_device = _arr0(train_data.num_bins)
             self._row_valid = None
             init_score = train_data.metadata.init_score
@@ -259,11 +285,43 @@ class GBDT:
             # device-side mask caches: uploads pay full link latency, so
             # only re-upload when the host-side mask actually changes
             self._bag_mask_device = jnp.asarray(self._bag_mask)
+        # device-side bagging (ISSUE 8, ops/sampling.py): redraws become a
+        # threefry key bump + on-device argsort — no host full-N RNG, no
+        # mask upload.  The draw counter is the whole rewindable state.
+        self._bag_device = self._resolve_bagging_device(boosting_config)
+        self._bag_draw_idx = 0
+        if self._bag_device:
+            from ..ops import sampling as _sampling
+            self._bag_base_key = _sampling.bag_key(
+                boosting_config.bagging_seed)
+            telemetry.count_route("bagging", "bagging/device")
+        elif self._use_bagging:
+            telemetry.count_route("bagging", "bagging/host")
         self._feat_mask_device = {}
         # per-class feature-fraction RNGs, same seed each
         # (serial_tree_learner.cpp:159-167; one learner per class)
         self._feat_rngs = [np.random.RandomState(self.tree_config.feature_fraction_seed)
                            for _ in range(self.num_class)]
+
+        # GOSS (ISSUE 8): device-side gradient-based one-side sampling —
+        # per-iteration top-|grad| rows plus an amplified random
+        # remainder, fed through the row-mask seam (ops/sampling.py)
+        self._goss_on = bool(getattr(boosting_config, "goss", False))
+        if self._goss_on:
+            if self._host_inputs:
+                log.fatal("goss=true is not supported in multi-process "
+                          "training in this revision (the device "
+                          "selection runs over the local row layout)")
+            from ..ops import sampling as _sampling
+            self._goss_key = _sampling.bag_key(
+                boosting_config.bagging_seed)
+            (self._goss_top_cnt, self._goss_other_cnt,
+             self._goss_amp) = _sampling.goss_counts(
+                N, boosting_config.top_rate, boosting_config.other_rate)
+            log.info("GOSS: keeping top %d rows by |grad| + %d amplified "
+                     "(x%.3f) random rows per iteration"
+                     % (self._goss_top_cnt, self._goss_other_cnt,
+                        self._goss_amp))
 
         if objective is not None:
             if self._mp and hasattr(objective, "globalize_layout"):
@@ -415,6 +473,33 @@ class GBDT:
 
     # ------------------------------------------------------------- iteration
 
+    def _resolve_bagging_device(self, boosting_config) -> bool:
+        """The ``bagging_device=`` resolution rule, single-homed: the env
+        hatch (LGBM_TPU_HOST_BAGGING=1) beats the config; "auto" is on
+        for accelerator backends only (the host path's numpy stream is
+        the historical draw — CPU runs keep it so recorded models stay
+        stable); explicit "true" forces the device draw anywhere it CAN
+        apply.  It cannot apply (warns and falls back on "true"):
+        multi-process shards (draws are per-local-shard host state) and
+        per-query bagging (the atomic-query draw is a host loop)."""
+        if not self._use_bagging:
+            return False
+        if os.environ.get("LGBM_TPU_HOST_BAGGING", "") == "1":
+            return False
+        mode = getattr(boosting_config, "bagging_device", "auto")
+        if mode == "false":
+            return False
+        capable = (not self._host_inputs
+                   and self.train_data.metadata.query_boundaries is None
+                   and self.train_data.metadata.queries is None)
+        if mode == "true":
+            if not capable:
+                log.warning("bagging_device=true cannot apply here "
+                            "(multi-process shard or per-query bagging); "
+                            "keeping the host draw")
+            return capable
+        return capable and jax.default_backend() != "cpu"
+
     def _draw_bag_mask(self, it: int) -> None:
         """Host-side bagging draw (GBDT::Bagging, gbdt.cpp:106-157):
         per-record, or per-query when query boundaries exist.  Updates
@@ -428,6 +513,22 @@ class GBDT:
         if not self._use_bagging or it % self.gbdt_config.bagging_freq != 0:
             return
         frac = self.gbdt_config.bagging_fraction
+        if self._bag_device:
+            # device draw (ISSUE 8, ops/sampling.py): the redraw is a key
+            # bump — fold_in(base_key, draw_idx) — and an on-device exact-
+            # count mask; no host RNG advances and nothing crosses the
+            # link.  _bag_draw_idx is the WHOLE rewindable stream state
+            # (the rollback machinery restores an integer instead of
+            # MT19937 state).  Per-query bagging never reaches here
+            # (_resolve_bagging_device keeps it on the host path).
+            from ..ops import sampling as _sampling
+            n = self.num_data
+            bag_cnt = int(frac * n)
+            self._bag_mask_device = _sampling.bag_mask_for_draw(
+                self._bag_base_key, self._bag_draw_idx, n, bag_cnt)
+            self._bag_draw_idx += 1
+            log.info("re-bagging, using %d data to train" % bag_cnt)
+            return
         qb = self.train_data.metadata.query_boundaries
         # multi-process: bag the LOCAL shard, like the reference's
         # per-machine Bagging over its own partition (gbdt.cpp:106-157)
@@ -457,6 +558,30 @@ class GBDT:
                         self._bag_mask)
                 else:
                     self._bag_mask_device = jnp.asarray(self._bag_mask)
+
+    def _goss_masks(self, grad, hess):
+        """Per-iteration GOSS selection (ISSUE 8, ops/sampling.py): keep
+        the top_rate fraction of rows by summed |gradient|, sample an
+        other_rate fraction of the remainder, amplify the sampled
+        remainder's gradients AND hessians by (1-top_rate)/other_rate.
+        Runs entirely on device; the returned mask feeds the growers'
+        row-mask seam (the same seam bagging uses), so a sampled
+        iteration never materializes full-row host intermediates.  The
+        draw is a pure function of (seed, iteration) — the pipelined
+        rollback machinery needs NO snapshot for it.
+
+        Returns ``(grad, hess, None)`` untouched when GOSS is off."""
+        if not self._goss_on:
+            return grad, hess, None
+        from ..ops import sampling as _sampling
+        with telemetry.span("goss") as sp:
+            g, h, mask = _sampling.goss_select(
+                jax.random.fold_in(self._goss_key, self.iter),
+                grad, hess, self._goss_top_cnt, self._goss_other_cnt,
+                self._goss_amp)
+            sp.fence(mask)
+        telemetry.count("goss/iterations")
+        return g, h, mask
 
     def _feature_sample(self, cls: int) -> np.ndarray:
         frac = self.tree_config.feature_fraction
@@ -492,24 +617,42 @@ class GBDT:
         iteration (pipelined rollback): bagging stream + mask caches and
         the per-class feature-fraction streams.  None-components skip the
         copy when the corresponding sampling is off."""
-        bag = self._bag_rng.get_state() if self._use_bagging else None
-        masks = ((self._bag_mask.copy(), self._bag_mask_device)
-                 if self._use_bagging else None)
+        bag = self._bag_snapshot()
         ff = ([r.get_state() for r in self._feat_rngs]
               if self.tree_config.feature_fraction < 1.0 else None)
-        return (bag, ff, masks)
+        return (bag, ff)
 
     def _rng_restore(self, snap) -> None:
         if snap is None:
             return
-        bag, ff, masks = snap
-        if bag is not None:
-            self._bag_rng.set_state(bag)
+        bag, ff = snap
+        self._bag_restore(bag)
         if ff is not None:
             for r, s in zip(self._feat_rngs, ff):
                 r.set_state(s)
-        if masks is not None:
-            self._bag_mask, self._bag_mask_device = masks
+
+    def _bag_snapshot(self):
+        """The bagging stream's full rewindable state, mode-aware: the
+        device stream is (draw counter, current device mask) — an integer
+        plus an immutable array reference; the host stream is (MT19937
+        state, host mask copy, device mask cache)."""
+        if not self._use_bagging:
+            return None
+        if self._bag_device:
+            return ("device", self._bag_draw_idx, self._bag_mask_device)
+        return ("host", self._bag_rng.get_state(), self._bag_mask.copy(),
+                self._bag_mask_device)
+
+    def _bag_restore(self, snap) -> None:
+        if snap is None:
+            return
+        if snap[0] == "device":
+            _, self._bag_draw_idx, self._bag_mask_device = snap
+        else:
+            _, state, mask, mask_dev = snap
+            self._bag_rng.set_state(state)
+            self._bag_mask = mask
+            self._bag_mask_device = mask_dev
 
     def flush_pipeline(self) -> bool:
         """Consume every deferred readback (pipelined boosting).  Called
@@ -563,11 +706,16 @@ class GBDT:
         if self.num_class == 1:
             grad = grad[None]
             hess = hess[None]
+        # GOSS selection runs ONCE per iteration over all classes'
+        # gradients (the amplified grad/hess feed the growers; health and
+        # the next iteration's gradients see the raw arrays)
+        g_grow, h_grow, goss_mask = self._goss_masks(grad, hess)
 
         for cls in range(self.num_class):
             self._bagging(self.iter)
             feature_mask = self._feature_sample(cls)
-            row_mask = self._bag_mask_device
+            row_mask = (goss_mask if goss_mask is not None
+                        else self._bag_mask_device)
             key = feature_mask.tobytes()
             if key not in self._feat_mask_device:
                 # one live entry suffices: the per-class feature RNGs share
@@ -582,8 +730,8 @@ class GBDT:
 
             with telemetry.span("grow") as sp:
                 tree_arrays = self._learner(
-                    self, self.bins_device, grad[cls], hess[cls], row_mask,
-                    self._feat_mask_device[key])
+                    self, self.bins_device, g_grow[cls], h_grow[cls],
+                    row_mask, self._feat_mask_device[key])
                 sp.fence(tree_arrays)
 
             # ONE host round-trip for everything the host needs (each
@@ -722,20 +870,22 @@ class GBDT:
         entry = {"iter_no": self.iter, "is_eval": is_eval, "cls": [],
                  "grad": grad, "hess": hess, "pre_rng": pre_rng,
                  "mon": mon}
+        g_grow, h_grow, goss_mask = self._goss_masks(grad, hess)
         lr = jnp.float32(self.gbdt_config.learning_rate)
         for cls in range(self.num_class):
             cls_pre = self._rng_snapshot()
             self._bagging(self.iter)
             feature_mask = self._feature_sample(cls)
-            row_mask = self._bag_mask_device
+            row_mask = (goss_mask if goss_mask is not None
+                        else self._bag_mask_device)
             key = feature_mask.tobytes()
             if key not in self._feat_mask_device:
                 self._feat_mask_device.clear()
                 self._feat_mask_device[key] = jnp.asarray(feature_mask)
             with telemetry.span("grow") as sp:
                 tree_arrays = self._learner(
-                    self, self.bins_device, grad[cls], hess[cls], row_mask,
-                    self._feat_mask_device[key])
+                    self, self.bins_device, g_grow[cls], h_grow[cls],
+                    row_mask, self._feat_mask_device[key])
                 sp.fence(tree_arrays)
             small = tree_arrays._replace(leaf_ids=None)
             try:
@@ -1061,7 +1211,15 @@ class GBDT:
         with row-shardable objective state — including in-program metric
         evaluation and early stopping (train metrics run on the
         all_gathered global score inside the shard_map chunk; AUC's
-        global sort included.  Validation sets ride replicated)."""
+        global sort included.  Validation sets ride replicated).
+
+        GOSS (ISSUE 8) excludes chunking in this revision: the fused scan
+        computes gradients in-program, but the GOSS selection must run on
+        each iteration's raw gradients BEFORE the grower sees them — a
+        per-iteration seam the chunk body does not expose yet.  GOSS runs
+        stay on the per-iteration path (run_training falls through)."""
+        if getattr(self, "_goss_on", False):
+            return False
         if self.supports_chunking:
             return True
         from ..parallel.learners import (DataParallelLearner,
@@ -1143,7 +1301,8 @@ class GBDT:
                 "serial, data-parallel or feature-parallel learner; any "
                 "configured metric "
                 "must have a device formulation (metrics/device.py) when "
-                "evaluation is consumed (see chunk_supported); use "
+                "evaluation is consumed, and goss=true is per-iteration "
+                "only (see chunk_supported); use "
                 "train_one_iter / run_training")
         if self._pipe is not None:
             # per-iteration entries pending (path switch): drain first
@@ -1238,7 +1397,7 @@ class GBDT:
         # snapshots for early/degenerate stops and tail truncation: training
         # must then look exactly like it stopped at that iteration — RNG
         # streams and train/valid scores included
-        bag_state = self._bag_rng.get_state() if has_bag else None
+        bag_state = self._bag_snapshot()
         ff_states = ([r.get_state() for r in self._feat_rngs]
                      if has_ff else None)
         score_before = self.score
@@ -1254,7 +1413,21 @@ class GBDT:
         # passes identical values; a committed local jnp array would clash
         # with the global-mesh program)
         _arr = np.asarray if self._host_inputs else jnp.asarray
-        if has_bag:
+        if has_bag and self._bag_device:
+            # device bagging (ISSUE 8): the chunk's [k, C, N] mask stack
+            # is computed ON DEVICE from the draw counter — the host
+            # contributes k*C key bumps instead of k*C full-N draws plus
+            # one k*C*N bool upload.  Non-redraw iterations carry the
+            # previous device mask, exactly like the host stacking loop.
+            masks = []
+            for i in range(k):
+                for cls in range(C):
+                    self._draw_bag_mask(base_iter + i)
+                    masks.append(self._bag_mask_device)
+            rm = jnp.stack(masks).reshape(k, C, N)
+            row_masks = (jnp.pad(rm, ((0, 0), (0, 0), (0, pad)))
+                         if pad else rm)
+        elif has_bag:
             # multi-process: local draws padded to the process block, then
             # lifted to one global row-sharded mask array
             width = self._mp_max_n if self._mp else N + pad
@@ -1550,7 +1723,7 @@ class GBDT:
         iterations' updates on device)."""
         C = self.num_class
         if bag_state is not None:
-            self._bag_rng.set_state(bag_state)
+            self._bag_restore(bag_state)
             for p in range(replay_pairs):
                 self._draw_bag_mask(self.iter + p // C)
         if ff_states is not None:

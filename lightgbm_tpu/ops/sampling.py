@@ -1,0 +1,111 @@
+"""Device-side row sampling: bagging mask draws and GOSS selection.
+
+The reference draws bagging indices on the host with a serial RNG
+(GBDT::Bagging, gbdt.cpp:106-157) — our boosting loop inherited that and
+paid a full-N ``bool`` host→device upload every ``bagging_freq``
+iterations (ISSUE 8 satellite: models/gbdt.py ``_bagging``).  This module
+moves the draw itself on-device:
+
+- **Bagging** (``bag_mask_for_draw``): one threefry key per redraw
+  (``fold_in(PRNGKey(bagging_seed), draw_index)``), exact in-bag count
+  like the reference (``int(bagging_fraction * n)`` rows without
+  replacement, via one uniform draw + argsort).  A redraw becomes a key
+  bump — no host RNG, no full-N transfer.  The draw is a pure function of
+  ``(seed, draw_index, n, bag_cnt)``, so the pipelined/chunked rollback
+  machinery replays it exactly by rewinding an integer counter instead of
+  copying numpy RNG state.  The legacy host path stays behind
+  ``LGBM_TPU_HOST_BAGGING=1`` (and ``bagging_device=false``) for A/B.
+
+- **GOSS** (``goss_select``): gradient-based one-side sampling (the
+  headline trick of the later LightGBM paper — PAPERS.md): keep the
+  ``top_rate`` fraction of rows by gradient magnitude, sample an
+  ``other_rate`` fraction of the remainder uniformly, and amplify the
+  sampled remainder's gradients AND hessians by
+  ``(1 - top_rate) / other_rate`` so split gains stay unbiased.  Rows are
+  scored by the summed absolute gradient across classes; everything —
+  sort, sample, amplification — runs on-device and feeds the existing
+  histogram kernels through the row-mask seam, so a sampled iteration
+  never materializes a full-row host intermediate.
+
+Both draws are deterministic given their key inputs; the oracle tests in
+tests/test_streaming.py replay the same formulas host-side.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("num_rows", "bag_cnt"))
+def _bag_mask(key, num_rows: int, bag_cnt: int):
+    # exact-count draw without replacement: rank one uniform per row and
+    # keep the bag_cnt smallest ranks (argsort is stable, so the mask is
+    # fully determined by the key even under tied uniforms)
+    u = jax.random.uniform(key, (num_rows,))
+    order = jnp.argsort(u)
+    return jnp.zeros((num_rows,), jnp.bool_).at[order[:bag_cnt]].set(True)
+
+
+def bag_key(bagging_seed: int):
+    """The base key of the device bagging stream."""
+    return jax.random.PRNGKey(bagging_seed)
+
+
+def bag_mask_for_draw(base_key, draw_index: int, num_rows: int,
+                      bag_cnt: int):
+    """[num_rows] bool in-bag mask for the ``draw_index``-th redraw of the
+    stream rooted at ``base_key`` — exactly ``bag_cnt`` rows in-bag."""
+    return _bag_mask(jax.random.fold_in(base_key, draw_index),
+                     num_rows, bag_cnt)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("top_cnt", "other_cnt", "amp"))
+def _goss_select(key, grad, hess, top_cnt: int, other_cnt: int,
+                 amp: float):
+    absg = jnp.sum(jnp.abs(grad.astype(jnp.float32)), axis=0)
+    n = absg.shape[0]
+    # descending gradient-magnitude order (stable: ties resolve by row
+    # index, deterministically)
+    order = jnp.argsort(-absg)
+    mask = jnp.zeros((n,), jnp.bool_).at[order[:top_cnt]].set(True)
+    rest = order[top_cnt:]
+    # uniform sample of other_cnt remainder rows, one key per iteration
+    u = jax.random.uniform(key, (n - top_cnt,))
+    pick = rest[jnp.argsort(u)[:other_cnt]]
+    mask = mask.at[pick].set(True)
+    w = jnp.ones((n,), jnp.float32).at[pick].set(jnp.float32(amp))
+    return grad * w, hess * w, mask
+
+
+def goss_select(key, grad, hess, top_cnt: int, other_cnt: int,
+                amp: float):
+    """GOSS row selection over per-class gradients.
+
+    Parameters
+    ----------
+    key : per-iteration PRNG key (``fold_in(PRNGKey(seed), iteration)``)
+    grad, hess : [num_class, num_rows] float arrays
+    top_cnt : rows kept by gradient magnitude (``int(top_rate * n)``)
+    other_cnt : remainder rows sampled uniformly (``int(other_rate * n)``)
+    amp : amplification of the sampled remainder,
+        ``(1 - top_rate) / other_rate``
+
+    Returns ``(grad', hess', mask)`` where grad'/hess' carry the
+    amplification on the sampled remainder (unselected rows' values are
+    irrelevant — the mask excludes them from histograms and root stats)
+    and ``mask`` is the [num_rows] bool selection.
+    """
+    return _goss_select(key, grad, hess, int(top_cnt), int(other_cnt),
+                        float(amp))
+
+
+def goss_counts(num_rows: int, top_rate: float, other_rate: float):
+    """The static (top_cnt, other_cnt, amp) triple for a dataset size —
+    single-homed so gbdt and the tests agree on rounding."""
+    top_cnt = int(top_rate * num_rows)
+    other_cnt = int(other_rate * num_rows)
+    amp = (1.0 - top_rate) / other_rate
+    return top_cnt, other_cnt, amp

@@ -118,6 +118,42 @@ def get_mesh(num_machines: Optional[int] = None,
     return Mesh(np.array(devices[:num_machines]), (axis_name,))
 
 
+def dataset_row_sharding(num_rows: int, shard_rows: bool = False,
+                         num_machines: Optional[int] = None,
+                         device_type: str = "",
+                         parallel_consumer: bool = False):
+    """Explicit placement for a streamed ``[F, N]`` bin matrix (ISSUE 8):
+    a NamedSharding over the ``(data,)`` mesh axis.
+
+    ``shard_rows=True`` (a single-process data-parallel consumer) shards
+    the row axis across the CONSUMING LEARNER's mesh — ``get_mesh(
+    num_machines)``, the exact device set the learner's jit(shard_map)
+    programs run over — when the row count divides it (their bins
+    in_spec is ``P(None, 'data')``, so the shards are picked up in
+    place).  A non-dividing row count, or ``parallel_consumer=True``
+    without ``shard_rows`` (the single-process feature-parallel
+    learner), commits the matrix REPLICATED on that same learner mesh:
+    a committed array's device set must equal the consuming program's,
+    so a one-device placement would make the learner's multi-device
+    shard_map raise "incompatible devices".  Only the serial consumer
+    (neither flag) gets the one-device ``(data,)`` mesh — still an
+    explicit placement, and numerically identical to the resident
+    loader's default-device array (a multi-device input would let GSPMD
+    repartition the serial grower's reductions and break
+    bit-identity)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    if shard_rows or parallel_consumer:
+        mesh = get_mesh(num_machines, DATA_AXIS, device_type)
+        num_devices = int(mesh.devices.size)
+        if (shard_rows and num_devices > 1 and num_rows > 0
+                and num_rows % num_devices == 0):
+            return NamedSharding(mesh, PartitionSpec(None, DATA_AXIS))
+        return NamedSharding(mesh, PartitionSpec())
+    devices = jax.devices(device_type) if device_type else jax.devices()
+    mesh = Mesh(np.array(devices[:1]), (DATA_AXIS,))
+    return NamedSharding(mesh, PartitionSpec())
+
+
 def get_rank() -> int:
     """Process rank for host-side data sharding (Network::rank)."""
     return jax.process_index()

@@ -124,6 +124,26 @@ cross-host span shards, hung-collective flight recorder):
    job with no record of what was in flight.  The clock is injectable
    (tests stall without real waits); the thread only ever reads state
    and writes the dump, never touching device APIs.
+
+ISSUE 8 — streaming ingestion (io/streaming.py):
+
+9. **Ingest spans + the ``ingest/*`` counter family**: a streamed
+   dataset load runs under an ``ingest`` span with sub-spans
+   ``ingest_count`` (pass-0 raw row count), ``ingest_pass1``
+   (label/side-column collection + pinned-index binning sample),
+   ``ingest_bin`` (per-chunk parse + quantize) and ``ingest_h2d``
+   (final transfer drain).  Counters: ``ingest/chunks`` and
+   ``ingest/rows`` (pass-2 progress), ``ingest/h2d_bytes`` (host→device
+   payload), ``ingest/h2d_wait_us`` (host time actually BLOCKED on
+   transfers) and ``ingest/overlap_hidden_us`` (upper-bound estimate of
+   wire time hidden behind host parse/bin work — the double buffer's
+   win; ``LGBM_TPU_INGEST_SYNC=1`` forces depth-0 transfers for the
+   bench A/B).  Routes: ``ingest/double_buffer_on|off``.  Device-side
+   sampling rides the same registry: ``bagging/device`` vs
+   ``bagging/host`` routes (ops/sampling.py draws vs the legacy host
+   RNG + full-N upload) and the ``goss/iterations`` counter under a
+   ``goss`` span.  scripts/telemetry_report.py renders the family with
+   derived H2D GB/s.
 """
 from __future__ import annotations
 

@@ -72,6 +72,12 @@ RATE_KEYS: Tuple[Tuple[str, str], ...] = (
     ("predict_b65536_rows_per_sec", "predict_b65536_spread"),
     ("predict_int8_b65536_rows_per_sec", "predict_int8_b65536_spread"),
     ("predict_b1024_rows_per_sec", "predict_b1024_spread"),
+    # streaming ingestion (ISSUE 8, bench.py --bench-ingest): rows/sec
+    # for the chunked parse->bin->HBM pipeline.  The double-buffer A/B,
+    # H2D GB/s and the peak-RSS assertion ride the record ungated
+    # (ingest_rss_ok false would be a correctness bug, not a trajectory
+    # drift — the bench lane itself surfaces it).
+    ("ingest_rows_per_sec", "ingest_spread"),
 )
 
 DEFAULT_FLOOR = 0.02      # minimum relative noise band when none recorded

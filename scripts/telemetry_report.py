@@ -198,6 +198,37 @@ def _interconnect_lines(ic):
     return out
 
 
+def _ingest_lines(counters, summary_phase_times):
+    """The ``ingest/*`` counter family (ISSUE 8, io/streaming.py) with
+    derived H2D GB/s: payload bytes over the host time actually blocked
+    on transfers, and over the whole ingest span (effective rate).  The
+    overlap-hidden estimate is the double buffer's measured win."""
+    out = ["Streaming ingestion (ingest/*)",
+           "------------------------------"]
+    fam = {k: v for k, v in counters.items() if k.startswith("ingest/")}
+    if not fam:
+        out.append("(no ingest counters — resident load, or telemetry "
+                   "was off during ingestion)")
+        return out
+    width = max(len(k) for k in fam)
+    for k, v in sorted(fam.items()):
+        val = _fmt_bytes(v) if k.endswith("_bytes") else str(v)
+        out.append(f"{k.ljust(width)}  {val}")
+    h2d = fam.get("ingest/h2d_bytes", 0)
+    wait_s = fam.get("ingest/h2d_wait_us", 0) / 1e6
+    hidden_s = fam.get("ingest/overlap_hidden_us", 0) / 1e6
+    span_s = (summary_phase_times or {}).get("ingest", 0.0)
+    if h2d and wait_s > 0:
+        out.append("H2D attained (blocked time)  %.2f GB/s"
+                   % (h2d / wait_s / 1e9))
+    if h2d and span_s > 0:
+        out.append("H2D effective (ingest span)  %.2f GB/s  over %.2f s"
+                   % (h2d / span_s / 1e9, span_s))
+    if hidden_s > 0:
+        out.append("overlap-hidden transfer time  %.2f s" % hidden_s)
+    return out
+
+
 def _compile_lines(comp):
     out = ["Compile observability", "---------------------"]
     if not comp:
@@ -334,6 +365,8 @@ def report(path: str, as_json: bool = False) -> int:
         for k, v in residency.items():
             val = _fmt_bytes(v) if k.endswith("_bytes") else str(v)
             out.append(f"  {k.ljust(width)}  {val:>12}")
+    out.append("")
+    out += _ingest_lines(counters, (summary or {}).get("phase_times"))
     out.append("")
     out += _roofline_lines(roofline)
     out.append("")
