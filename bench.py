@@ -284,6 +284,61 @@ INGEST_COPY_KEYS = (
 )
 
 
+def bench_wire(args) -> int:
+    """Hybrid/voting wire-bytes lane (ISSUE 9): train the bench schema
+    (--features, --max-bin) under ``tree_learner=data`` (pure-DP psum),
+    ``hybrid`` and ``voting`` on a simulated (2, 2) mesh and print one
+    JSON line with the telemetry interconnect block's LOGICAL
+    ``wire_bytes_per_iter`` per learner plus the per-site est-bytes.
+
+    Not a timing lane: the numbers are deterministic (traced shapes x
+    loop estimates).  The GATED copy of this series rides the MULTICHIP
+    trajectory (__graft_entry__._wire_smoke prints the MULTICHIP_WIRE
+    line perf_gate.py checks); this lane reads the same numbers at
+    arbitrary schemas, next to the comm-cost model in PROFILE.md
+    (F·B·4B DP vs F·B/fs hybrid vs 2k·B voting per split).
+
+    Histograms are pinned to float32 regardless of --hist-dtype: under
+    int8 the int accumulators deliberately ride the FULL data-axis psum
+    (voting_seams — local caches would break the int-domain bit-identity
+    chain), so the voting wire saving the lane prices exists on the
+    float paths only."""
+    import sys as _sys
+
+    import __graft_entry__ as graft
+    device_type = graft._provision_devices(4)
+
+    from lightgbm_tpu.io.dataset import Dataset
+    from lightgbm_tpu.utils import log
+
+    log.set_stream(_sys.stderr)
+    log.set_level(log.WARNING)
+
+    rows = min(args.rows, 65536)     # logical bytes don't scale with rows
+    x, y = make_data(rows, args.features)
+    ds = Dataset.from_arrays(x, y, max_bin=args.max_bin)
+    out = graft.measure_wire_bytes(
+        ds, device_type,
+        {"objective": "binary", "num_leaves": str(args.leaves),
+         "min_data_in_leaf": "4", "min_sum_hessian_in_leaf": "0.1",
+         "learning_rate": "0.1", "grow_policy": args.grow_policy,
+         "hist_dtype": "float32"},
+        (("data", {}),
+         ("hybrid", {"feature_shards": "2"}),
+         # 4k < F/fs — the leaf-wise voting-beats-hybrid regime (the
+         # depthwise schedules have no subtraction trick to amortize, so
+         # there 2k < F/fs suffices)
+         ("voting", {"feature_shards": "2", "top_k": "2"})))
+    out.update({"metric": "wire_2x2"})
+    out["schema"].update({"rows": rows, "leaves": args.leaves,
+                          "hist_dtype": "float32"})
+    w = out["wire_bytes_per_iter"]
+    out["ok"] = bool(0 < w.get("hybrid", 0) < w.get("data", 0)
+                     and 0 < w.get("voting", 0) < w.get("hybrid", 0))
+    print(json.dumps(out))
+    return 0 if out["ok"] else 1
+
+
 def bench_ingest(args) -> int:
     """Streaming-ingestion lane (ISSUE 8, io/streaming.py): rows/sec for
     the full chunked parse→bin→HBM pipeline, the double-buffer on/off
@@ -495,6 +550,14 @@ def main() -> int:
                         help="streaming loader chunk length for "
                              "--bench-ingest (the ingest_chunk_rows= "
                              "knob)")
+    parser.add_argument("--bench-wire", action="store_true",
+                        help="wire-bytes lane (ISSUE 9): tree_learner="
+                             "data vs hybrid vs voting on a simulated "
+                             "(2,2) mesh at the bench schema; prints the "
+                             "per-learner logical wire_bytes_per_iter "
+                             "and per-site interconnect est-bytes (the "
+                             "gated copy rides the MULTICHIP "
+                             "trajectory)")
     parser.add_argument("--bench-predict", action="store_true",
                         help="serving benchmark (ISSUE 7): train a model "
                              "(rows clamped to 1M, --iters trees), then "
@@ -507,6 +570,8 @@ def main() -> int:
         return bench_ingest(args)
     if args.bench_predict:
         return bench_predict(args)
+    if args.bench_wire:
+        return bench_wire(args)
     if (args.hist_dtype != "int8" and args.rows > 4_000_000
             and args.grow_policy == "depthwise"):
         # one fused dispatch of --iters f32 iterations at this scale would

@@ -27,6 +27,21 @@ for A/B).  All four are score transforms of the SAME model — only
 ``predict_quantize=int8`` changes values, by the documented quantization
 step.
 
+Parallel-training knobs (ISSUE 9 — lightgbm_tpu/parallel/):
+``tree_learner`` now spans ``serial|feature|data|hybrid|voting``.
+``hybrid`` trains on an explicit 2-D ``(data, feature)`` mesh —
+``num_machines = data_shards × feature_shards``, rows sharded on
+``data``, feature-block ownership on ``feature``, per-shard histogram
+wire bytes cut by ``feature_shards`` — and ``voting`` realizes the
+reference's named-but-absent PV-tree mode (top-k per-shard split
+voting; full histograms exchanged only for the ≤2·top_k voted
+features).  ``feature_shards`` (0 = auto-factor; nonzero must divide
+``num_machines``) picks the mesh factoring and ``top_k`` (default 20)
+the vote width.  Both learners hold the repo's standing equivalence
+bar vs serial (int8 bit-identical; f32 tie-keyed) — voting is exact
+whenever 2·top_k covers the owned block, the PV-tree approximation
+beyond that.
+
 Streaming ingestion & on-device sampling knobs (ISSUE 8 —
 lightgbm_tpu/io/streaming.py + ops/sampling.py): ``streaming``
 (``auto`` engages the chunked parse→bin→HBM loader for files ≥256 MB;

@@ -79,6 +79,7 @@ ALIAS_TABLE: Dict[str, str] = {
     "query_column": "group_column",
     "ignore_feature": "ignore_column",
     "blacklist": "ignore_column",
+    "topk": "top_k",
 }
 
 
@@ -455,6 +456,20 @@ class TreeConfig:
     # learner keeps the uniform layout (its per-shard ownership slices
     # are arbitrary feature subsets).
     mixed_bin: str = "auto"
+    # 2-D hybrid mesh factoring (ISSUE 9, tree_learner=hybrid|voting):
+    # num_machines = data_shards x feature_shards.  0 = auto
+    # (parallel/mesh.factor_machines: hybrid takes the largest divisor
+    # <= sqrt(num_machines) as feature_shards, voting defaults to pure
+    # data-parallel); a nonzero value must divide num_machines.
+    feature_shards: int = 0
+    # voting-parallel top-k (tree_learner=voting; the reference family's
+    # ``top_k``/PV-tree parameter, default 20): each data shard proposes
+    # its top_k features by local split gain, and full histograms are
+    # exchanged only for the <= 2*top_k globally-voted features per
+    # owned block.  Voting is exact whenever the voted set covers the
+    # true best feature — guaranteed when 2*top_k >= features-per-block,
+    # the reference's own accuracy argument otherwise.
+    top_k: int = 20
     # int8 rounding mode: "nearest" (default) or "stochastic" — unbiased
     # floor(y+u) with deterministic value-keyed uniform bits
     # (ops/hist_pallas.stochastic_bits); preserves the serial==distributed
@@ -511,6 +526,12 @@ class TreeConfig:
             log.check(value in ("auto", "true", "false"),
                       "mixed_bin must be auto, true or false")
             self.mixed_bin = value
+        self.feature_shards = _get_int(params, "feature_shards",
+                                       self.feature_shards)
+        log.check(self.feature_shards >= 0,
+                  "feature_shards should be >= 0")
+        self.top_k = _get_int(params, "top_k", self.top_k)
+        log.check(self.top_k >= 1, "top_k should be >= 1")
         if "quant_rounding" in params:
             value = params["quant_rounding"].lower()
             log.check(value in ("nearest", "stochastic"),
@@ -653,8 +674,17 @@ class BoostingConfig:
                 self.tree_learner = "feature"
             elif value in ("data", "data_parallel"):
                 self.tree_learner = "data"
+            elif value == "hybrid":
+                # 2-D (data, feature) mesh: rows sharded on ``data``,
+                # feature-block ownership on ``feature`` (ISSUE 9)
+                self.tree_learner = "hybrid"
+            elif value in ("voting", "voting_parallel"):
+                # the reference NAMES voting but Fatals on it
+                # (src/io/config.cpp:311-313); here it is realized: top-k
+                # per-shard split voting, full histograms exchanged only
+                # for the voted features (ISSUE 9)
+                self.tree_learner = "voting"
             else:
-                # reference rejects "voting" in this snapshot (config.cpp:311-313)
                 log.fatal("Tree learner type error")
         self.tree_config.set(params)
 
@@ -766,7 +796,11 @@ class OverallConfig:
             self.network_config.num_machines = 1
         if self.boosting_config.tree_learner in ("serial", "feature"):
             self.is_parallel_find_bin = False
-        elif self.boosting_config.tree_learner == "data":
+        elif self.boosting_config.tree_learner in ("data", "hybrid",
+                                                   "voting"):
+            # hybrid/voting shard rows over the data axis exactly like
+            # tree_learner=data, so they take the same distributed bin
+            # finding + LRU-queue-off treatment
             self.is_parallel_find_bin = True
             if self.boosting_config.tree_config.histogram_pool_size >= 0:
                 log.warning(

@@ -141,10 +141,15 @@ class GBDT:
         mixed_mode = getattr(self.tree_config, "mixed_bin", "auto")
         self._pack_spec = None
         if (learner is not None
-                and type(learner).__name__ == "FeatureParallelLearner"):
+                and (type(learner).__name__ == "FeatureParallelLearner"
+                     or getattr(learner, "needs_uniform_layout", False))):
+            # feature ownership (feature-parallel slices; hybrid/voting
+            # contiguous blocks) and the class-contiguous packed layout
+            # do not compose
             if mixed_mode == "true":
-                log.warning("mixed_bin is not supported by the feature-"
-                            "parallel learner; keeping the uniform layout")
+                log.warning("mixed_bin is not supported by %s; "
+                            "keeping the uniform layout"
+                            % type(learner).__name__)
         else:
             self._pack_spec = train_data.plan_packing(mode=mixed_mode)
         if self._pack_spec is not None:

@@ -1,110 +1,28 @@
-"""Leaf-wise tree grower — the device-side tree learner.
+"""Leaf-wise grower — compat shim over ``models/grower_unified.py``.
 
-TPU-native re-design of SerialTreeLearner
-(/root/reference/src/treelearner/serial_tree_learner.cpp:10-440).  The whole
-tree grows inside ONE jitted function: a ``lax.fori_loop`` over the
-``num_leaves - 1`` splits with fully static shapes, so a boosting iteration is
-a single XLA program with no host round-trips per split.
-
-Inversions of the reference's pointer design (SURVEY §7.0):
-- DataPartition's permuted index lists (data_partition.hpp) become a
-  ``[N]`` leaf-id vector; Split is a masked where-update.
-- The LRU histogram pool (utils/lru_pool.h) becomes a dense
-  ``[num_leaves, F, B, 3]`` histogram cache carried through the loop.
-- The smaller-leaf + histogram-subtraction trick
-  (serial_tree_learner.cpp:262-283, feature_histogram.hpp:91-100) is kept:
-  each split builds ONE masked histogram (the smaller child) and derives the
-  sibling by parent − smaller.
-- Data-dependent leaf choice (serial_tree_learner.cpp:140-150) is a masked
-  argmax over per-leaf candidate gains; early stop (best gain ≤ 0) is a
-  ``done`` flag that short-circuits the remaining iterations via lax.cond.
+The three grower modules were collapsed into ONE schedule-parameterized
+grower (ISSUE 9): growth policy (leafwise/depthwise/leafcompact) and a
+declarative :class:`~.grower_unified.SeamSchedule` are parameters there;
+this module keeps the historical leaf-wise entry points (``grow_tree``,
+``grow_tree_impl`` with keyword seams, ``grow_tree_segmented``) and the
+shared ``TreeArrays``/``_GrowState`` types.  New code should import from
+``grower_unified`` directly.
 """
 from __future__ import annotations
 
-import functools
-from typing import NamedTuple
-
-import jax
 import jax.numpy as jnp
 
-from ..ops.histogram import build_histogram
-from ..ops.split import SplitResult, find_best_split
+# patchable histogram seam: tests/scripts monkeypatch THIS attribute
+# (the unified grower resolves it through this module at trace time)
+from ..ops.histogram import build_histogram  # noqa: F401
+
+from .grower_unified import (  # noqa: F401
+    BIG, SeamSchedule, TreeArrays, _GrowState, _grow_init, _grow_segment,
+    grow_tree, grow_tree_segmented, grow_tree_unified)
 
 
-class TreeArrays(NamedTuple):
-    """Fixed-shape device tree (mirrors tree.h:124-149)."""
-    num_leaves: jax.Array       # i32 scalar
-    split_feature: jax.Array    # [L-1] i32
-    threshold_bin: jax.Array    # [L-1] i32
-    split_gain: jax.Array       # [L-1] f32
-    left_child: jax.Array       # [L-1] i32 (~leaf encoding)
-    right_child: jax.Array      # [L-1] i32
-    leaf_parent: jax.Array      # [L] i32
-    leaf_value: jax.Array       # [L] f32
-    leaf_count: jax.Array       # [L] i32
-    leaf_ids: jax.Array         # [N] i32 — final row → leaf partition
-
-
-class _GrowState(NamedTuple):
-    tree: TreeArrays
-    hist_cache: jax.Array       # [L, F, B, 3]
-    cand_gain: jax.Array        # [L]
-    cand_feature: jax.Array     # [L]
-    cand_threshold: jax.Array   # [L]
-    cand_left_out: jax.Array    # [L]
-    cand_right_out: jax.Array
-    cand_left_cnt: jax.Array    # [L] i32
-    cand_right_cnt: jax.Array
-    cand_left_g: jax.Array
-    cand_left_h: jax.Array
-    cand_right_g: jax.Array
-    cand_right_h: jax.Array
-    leaf_sum_g: jax.Array       # [L]
-    leaf_sum_h: jax.Array
-    leaf_cnt: jax.Array         # [L] i32
-    leaf_depth: jax.Array       # [L] i32
-    done: jax.Array             # bool scalar
-
-
-def _grow_tree_fn(bins: jax.Array, grad: jax.Array, hess: jax.Array,
-                  row_mask: jax.Array, feature_mask: jax.Array,
-                  num_bins: jax.Array, *, num_leaves: int, num_bins_max: int,
-                  min_data_in_leaf: int, min_sum_hessian_in_leaf: float,
-                  max_depth: int = -1, hist_backend: str = "matmul",
-                  hist_chunk: int = 16384,
-                  compute_dtype=jnp.float32, packing=None) -> TreeArrays:
-    """Grow one tree on a single device (TreeLearner::Train,
-    serial_tree_learner.cpp:119-153).  See ``grow_tree_impl`` for the
-    customization seam used by the parallel learners.
-    """
-    return grow_tree_impl(
-        bins, grad, hess, row_mask, feature_mask, num_bins,
-        num_leaves=num_leaves, num_bins_max=num_bins_max,
-        min_data_in_leaf=min_data_in_leaf,
-        min_sum_hessian_in_leaf=min_sum_hessian_in_leaf,
-        max_depth=max_depth, hist_backend=hist_backend,
-        hist_chunk=hist_chunk, compute_dtype=compute_dtype,
-        packing=packing)
-
-
-# module-level jit shared across boosters, wrapped in the cost registry
-# (lightgbm_tpu/costmodel.py): with telemetry armed, the compiled program's
-# cost_analysis/compile seconds feed the roofline/compile blocks
-from .. import costmodel as _costmodel  # noqa: E402 (after jax imports)
-
-grow_tree = _costmodel.instrument(
-    "grow/leafwise",
-    jax.jit(_grow_tree_fn,
-            static_argnames=("num_leaves", "num_bins_max",
-                             "min_data_in_leaf", "min_sum_hessian_in_leaf",
-                             "max_depth", "hist_backend", "hist_chunk",
-                             "compute_dtype", "packing")),
-    phase="grow")
-
-
-def grow_tree_impl(bins: jax.Array, grad: jax.Array, hess: jax.Array,
-                   row_mask: jax.Array, feature_mask: jax.Array,
-                   num_bins: jax.Array, *, num_leaves: int, num_bins_max: int,
+def grow_tree_impl(bins, grad, hess, row_mask, feature_mask, num_bins, *,
+                   num_leaves: int, num_bins_max: int,
                    min_data_in_leaf: int, min_sum_hessian_in_leaf: float,
                    max_depth: int = -1, hist_backend: str = "matmul",
                    hist_chunk: int = 16384, compute_dtype=jnp.float32,
@@ -114,366 +32,21 @@ def grow_tree_impl(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                    stat_reduce=None, own_slice=None, root_hist_reduce=None,
                    init_state=None, loop_count=None,
                    return_state: bool = False):
-    """Core grower (not jitted; callers wrap it).
-
-    Parameters
-    ----------
-    bins : [F, N] integer bin matrix (the Dataset layout; N may be the local
-        row shard under shard_map)
-    grad, hess : [N] f32 gradients/hessians from the objective
-    row_mask : [N] bool — bagging × validity mask; masked rows still get leaf
-        ids (OOB score updates come free, unlike gbdt.cpp:159-165)
-    feature_mask : [F] bool — feature_fraction sample
-        (serial_tree_learner.cpp:159-167), possibly ∧ per-shard feature
-        ownership for the feature-parallel learner
-    num_bins : [F] i32 real bin counts
-    packing : optional io/binning.PackSpec (STATIC) — mixed-bin layout:
-        ``bins`` is stored in packed bin-width-class feature order; the
-        histogram routes run one pass per class and hand back
-        CANONICAL-order histograms, so num_bins/feature_mask/split
-        results stay canonical.  Only partition-time feature indexing
-        translates through the spec's canonical->packed map.
-    hist_reduce : optional callable hist→hist; the data-parallel learner
-        passes ``lambda h: psum(h, 'data')`` (the ReduceScatter+Allgather
-        contract of data_parallel_tree_learner.cpp:135-165).  Under the
-        reduce_scatter ownership schedule it is instead a feature-block
-        psum_scatter, so every histogram (and the cache) holds only this
-        shard's OWNED feature block — the split_finder must then be the
-        owned-search + SplitInfo-allreduce composite and feature_mask /
-        num_bins the owned slices (learners._scatter_grow_fn_leafwise)
-    int_hist_reduce : optional int-domain feature-block scatter for the
-        quantized path (forwarded to build_histogram's int_reduce so the
-        accumulators never leave the exact int domain)
-    split_finder : optional callable with find_best_split's signature; the
-        feature-parallel learner wraps it with the packed SplitInfo argmax
-        allreduce (feature_parallel_tree_learner.cpp:46-79) and must return
-        GLOBAL feature indices
-    partition_bins : optional [F_global, N] matrix used to apply splits; the
-        feature-parallel learner histograms only its OWNED feature slice
-        (``bins``) but applies splits on the replicated full matrix, exactly
-        like the reference where every worker holds all data and Split is
-        local (feature_parallel_tree_learner.cpp:9-81)
-    init_state / loop_count / return_state : dispatch-segmentation seam
-        (grow_tree_segmented): resume from a carried _GrowState instead of
-        the root init, run only ``loop_count`` split attempts, and return
-        the full state so the caller can continue in a later dispatch.  The
-        body never reads the loop index, so splitting fori_loop(0, L-1)
-        into count-sized pieces is EXACTLY the same program.
-    """
-    F, N = bins.shape
-    L = num_leaves
-    B = num_bins_max
-    f32 = jnp.float32
-    finder = split_finder or find_best_split
-    if partition_bins is None:
-        partition_bins = bins
-    # wire-metrics hook point (ISSUE 5): any seam not already labeled by
-    # the learner that built it (telemetry.collective_span passes wrapped
-    # fns through) gets a grower-generic site here, so custom learners'
-    # collectives still show up in the interconnect block.  The wrappers
-    # call the seam unchanged — traced programs are bit-identical.
-    from .. import telemetry as _tl
-    hist_reduce = _tl.collective_span(
-        "leafwise/hist_reduce", hist_reduce, kind="reduce", axis=hist_axis,
-        loop=L - 1, phase="grow")
-    int_hist_reduce = _tl.collective_span(
-        "leafwise/int_hist_reduce", int_hist_reduce, kind="reduce",
-        axis=hist_axis, loop=L - 1, phase="grow")
-    stat_reduce = _tl.collective_span(
-        "leafwise/root_stats", stat_reduce, kind="reduce", axis=hist_axis,
-        phase="grow")
-    root_hist_reduce = _tl.collective_span(
-        "leafwise/root_hist", root_hist_reduce, kind="reduce",
-        axis=hist_axis, phase="grow")
-
-    def hist_of(mask, salt=0):
-        hist = build_histogram(bins, grad, hess, mask, B,
-                               backend=hist_backend, chunk=hist_chunk,
-                               compute_dtype=compute_dtype,
-                               axis_name=hist_axis,
-                               int_reduce=int_hist_reduce, salt=salt,
-                               packing=packing)
-        # the quantized path reduces its INT accumulators internally over
-        # hist_axis (bit-exactness; ops/hist_pallas.quantize_values) —
-        # psum by default, the ownership feature-block scatter when
-        # int_hist_reduce is set
-        if hist_reduce is not None and not (
-                str(compute_dtype).startswith("int8")
-                and hist_axis is not None):
-            hist = hist_reduce(hist)
-        return hist
-
-    def best_of(hist, sum_g, sum_h, cnt, depth):
-        res = finder(hist, sum_g, sum_h, cnt, num_bins, feature_mask,
-                     float(min_data_in_leaf),
-                     float(min_sum_hessian_in_leaf))
-        if max_depth > 0:
-            # depth-limited leaves cannot split (serial_tree_learner.cpp:240-249)
-            blocked = depth >= max_depth
-            res = res._replace(gain=jnp.where(blocked, -jnp.inf, res.gain))
-        return res
-
-    # ---- root init (BeforeTrain, serial_tree_learner.cpp:155-236);
-    # skipped entirely when resuming from a carried state (segmentation)
-    def _root_state() -> _GrowState:
-        if own_slice is not None:
-            # ownership (reduce_scatter) schedule: build the ROOT
-            # replicated — full F, plain psum — so root stats are exact on
-            # every shard including feature-PADDING shards (whose owned
-            # block is all zeros), then cache only the owned slice.  The
-            # depthwise scatter path does the same (learners.py own_slice).
-            full = build_histogram(bins, grad, hess, row_mask, B,
-                                   backend=hist_backend, chunk=hist_chunk,
-                                   compute_dtype=compute_dtype,
-                                   axis_name=hist_axis, packing=packing)
-            if root_hist_reduce is not None and not (
-                    str(compute_dtype).startswith("int8")
-                    and hist_axis is not None):
-                full = root_hist_reduce(full)
-            root_hist = own_slice(full)
-        else:
-            full = root_hist = hist_of(row_mask)
-        if str(compute_dtype).startswith("int8"):
-            # quantized mode: derive root stats from the histogram — the
-            # int accumulators are bit-identical across serial/
-            # data-parallel (see grower_depthwise.py root-stat note), and
-            # any feature's bins sum to the same exact quantized totals, so
-            # this also holds under feature-parallel ownership slices
-            # (``full``: under the reduce_scatter schedule the stats must
-            # come from the replicated full-F root, not the owned block —
-            # a feature-padding shard's block is all zeros)
-            root_stats = jnp.sum(full[0], axis=0)
-        else:
-            # root sums come from the gradient vectors, not from any one
-            # feature's histogram: per-feature f32 bin-order rounding would
-            # make the totals shard-dependent under feature-parallel
-            # ownership (the reference likewise computes root sums once
-            # from gradients, serial_tree_learner.cpp:178-198 /
-            # data_parallel root-sum allreduce)
-            maskf = row_mask.astype(f32)
-            root_stats = jnp.stack([jnp.sum(grad * maskf),
-                                    jnp.sum(hess * maskf), jnp.sum(maskf)])
-            if stat_reduce is not None:
-                root_stats = stat_reduce(root_stats)
-        root_g, root_h, root_c = root_stats[0], root_stats[1], root_stats[2]
-        root_best = best_of(root_hist, root_g, root_h, root_c,
-                            jnp.asarray(1, jnp.int32))
-
-        neg_inf = jnp.full((L,), -jnp.inf, dtype=f32)
-        zeros_i = jnp.zeros((L,), dtype=jnp.int32)
-        zeros_f = jnp.zeros((L,), dtype=f32)
-
-        tree = TreeArrays(
-            num_leaves=jnp.asarray(1, jnp.int32),
-            split_feature=jnp.zeros((L - 1,), jnp.int32),
-            threshold_bin=jnp.zeros((L - 1,), jnp.int32),
-            split_gain=jnp.zeros((L - 1,), f32),
-            left_child=jnp.zeros((L - 1,), jnp.int32),
-            right_child=jnp.zeros((L - 1,), jnp.int32),
-            leaf_parent=jnp.full((L,), -1, jnp.int32),
-            leaf_value=zeros_f,
-            leaf_count=zeros_i.at[0].set(root_c.astype(jnp.int32)),
-            leaf_ids=jnp.zeros((N,), jnp.int32),
-        )
-        return _GrowState(
-            tree=tree,
-            hist_cache=jnp.zeros((L,) + root_hist.shape,
-                                 f32).at[0].set(root_hist),
-            cand_gain=neg_inf.at[0].set(root_best.gain),
-            cand_feature=zeros_i.at[0].set(root_best.feature),
-            cand_threshold=zeros_i.at[0].set(root_best.threshold),
-            cand_left_out=zeros_f.at[0].set(root_best.left_output),
-            cand_right_out=zeros_f.at[0].set(root_best.right_output),
-            cand_left_cnt=zeros_i.at[0].set(root_best.left_count),
-            cand_right_cnt=zeros_i.at[0].set(root_best.right_count),
-            cand_left_g=zeros_f.at[0].set(root_best.left_sum_grad),
-            cand_left_h=zeros_f.at[0].set(root_best.left_sum_hess),
-            cand_right_g=zeros_f.at[0].set(root_best.right_sum_grad),
-            cand_right_h=zeros_f.at[0].set(root_best.right_sum_hess),
-            leaf_sum_g=zeros_f.at[0].set(root_g),
-            leaf_sum_h=zeros_f.at[0].set(root_h),
-            leaf_cnt=zeros_i.at[0].set(root_c.astype(jnp.int32)),
-            leaf_depth=zeros_i.at[0].set(1),
-            done=jnp.asarray(False),
-        )
-
-    state = init_state if init_state is not None else _root_state()
-
-    def body(_, state: _GrowState) -> _GrowState:
-        # pick the best leaf to split (FindBestSplitsForLeaves argmax,
-        # serial_tree_learner.cpp:140-147)
-        best_leaf = jnp.argmax(state.cand_gain).astype(jnp.int32)
-        best_gain = state.cand_gain[best_leaf]
-        should_split = jnp.logical_and(~state.done, best_gain > 0.0)
-
-        def do_split(state: _GrowState) -> _GrowState:
-            tree = state.tree
-            bl = best_leaf
-            nl = tree.num_leaves
-            node = nl - 1
-            new_leaf = nl
-
-            feat = state.cand_feature[bl]
-            thr = state.cand_threshold[bl]
-
-            # --- record the node (Tree::Split, tree.cpp:50-83)
-            p = tree.leaf_parent[bl]
-            pp = jnp.maximum(p, 0)
-            lc_at_p = jnp.where((p >= 0) & (tree.left_child[pp] == ~bl),
-                                node, tree.left_child[pp])
-            rc_at_p = jnp.where((p >= 0) & (tree.right_child[pp] == ~bl),
-                                node, tree.right_child[pp])
-            left_child = tree.left_child.at[pp].set(lc_at_p).at[node].set(~bl)
-            right_child = (tree.right_child.at[pp].set(rc_at_p)
-                           .at[node].set(~new_leaf))
-
-            # --- partition rows (DataPartition::Split as masked where,
-            # data_partition.hpp:93-139).  Under mixed-bin packing the
-            # matrix rows are in packed order while ``feat`` is canonical:
-            # translate through the (trace-time constant) c2p map
-            pfeat = feat
-            if packing is not None and len(packing.widths) > 1:
-                pfeat = jnp.asarray(packing.c2p, jnp.int32)[feat]
-            fbin = jax.lax.dynamic_index_in_dim(
-                partition_bins, pfeat, axis=0, keepdims=False).astype(jnp.int32)
-            go_right = fbin > thr
-            leaf_ids = jnp.where((tree.leaf_ids == bl) & go_right,
-                                 new_leaf, tree.leaf_ids)
-
-            # --- child histograms: build the smaller, subtract for the larger
-            # (serial_tree_learner.cpp:262-283)
-            lcnt = state.cand_left_cnt[bl]
-            rcnt = state.cand_right_cnt[bl]
-            left_is_smaller = lcnt <= rcnt
-            small_leaf = jnp.where(left_is_smaller, bl, new_leaf)
-            small_mask = row_mask & (leaf_ids == small_leaf)
-            # salt = the new leaf index: varies per split pass so the
-            # stochastic-rounding bits decorrelate across passes
-            small_hist = hist_of(small_mask, salt=new_leaf)
-            parent_hist = state.hist_cache[bl]
-            large_hist = parent_hist - small_hist
-            lhist = jnp.where(left_is_smaller, small_hist, large_hist)
-            rhist = jnp.where(left_is_smaller, large_hist, small_hist)
-            hist_cache = state.hist_cache.at[bl].set(lhist).at[new_leaf].set(rhist)
-
-            # --- child stats
-            lg, lh = state.cand_left_g[bl], state.cand_left_h[bl]
-            rg, rh = state.cand_right_g[bl], state.cand_right_h[bl]
-            depth = state.leaf_depth[bl] + 1
-
-            # --- new candidate splits for both children
-            lbest = best_of(lhist, lg, lh, lcnt.astype(f32), depth)
-            rbest = best_of(rhist, rg, rh, rcnt.astype(f32), depth)
-
-            tree = tree._replace(
-                num_leaves=nl + 1,
-                split_feature=tree.split_feature.at[node].set(feat),
-                threshold_bin=tree.threshold_bin.at[node].set(thr),
-                split_gain=tree.split_gain.at[node].set(best_gain),
-                left_child=left_child,
-                right_child=right_child,
-                leaf_parent=tree.leaf_parent.at[bl].set(node)
-                                            .at[new_leaf].set(node),
-                leaf_value=tree.leaf_value.at[bl].set(state.cand_left_out[bl])
-                                          .at[new_leaf].set(state.cand_right_out[bl]),
-                leaf_count=tree.leaf_count.at[bl].set(lcnt)
-                                          .at[new_leaf].set(rcnt),
-                leaf_ids=leaf_ids,
-            )
-            return state._replace(
-                tree=tree,
-                hist_cache=hist_cache,
-                cand_gain=state.cand_gain.at[bl].set(lbest.gain)
-                                         .at[new_leaf].set(rbest.gain),
-                cand_feature=state.cand_feature.at[bl].set(lbest.feature)
-                                               .at[new_leaf].set(rbest.feature),
-                cand_threshold=state.cand_threshold.at[bl].set(lbest.threshold)
-                                                   .at[new_leaf].set(rbest.threshold),
-                cand_left_out=state.cand_left_out.at[bl].set(lbest.left_output)
-                                                 .at[new_leaf].set(rbest.left_output),
-                cand_right_out=state.cand_right_out.at[bl].set(lbest.right_output)
-                                                   .at[new_leaf].set(rbest.right_output),
-                cand_left_cnt=state.cand_left_cnt.at[bl].set(lbest.left_count)
-                                                 .at[new_leaf].set(rbest.left_count),
-                cand_right_cnt=state.cand_right_cnt.at[bl].set(lbest.right_count)
-                                                   .at[new_leaf].set(rbest.right_count),
-                cand_left_g=state.cand_left_g.at[bl].set(lbest.left_sum_grad)
-                                             .at[new_leaf].set(rbest.left_sum_grad),
-                cand_left_h=state.cand_left_h.at[bl].set(lbest.left_sum_hess)
-                                             .at[new_leaf].set(rbest.left_sum_hess),
-                cand_right_g=state.cand_right_g.at[bl].set(lbest.right_sum_grad)
-                                               .at[new_leaf].set(rbest.right_sum_grad),
-                cand_right_h=state.cand_right_h.at[bl].set(lbest.right_sum_hess)
-                                               .at[new_leaf].set(rbest.right_sum_hess),
-                leaf_sum_g=state.leaf_sum_g.at[bl].set(lg).at[new_leaf].set(rg),
-                leaf_sum_h=state.leaf_sum_h.at[bl].set(lh).at[new_leaf].set(rh),
-                leaf_cnt=state.leaf_cnt.at[bl].set(lcnt).at[new_leaf].set(rcnt),
-                leaf_depth=state.leaf_depth.at[bl].set(depth)
-                                           .at[new_leaf].set(depth),
-            )
-
-        def no_split(state: _GrowState) -> _GrowState:
-            return state._replace(done=jnp.asarray(True))
-
-        # profiler alignment (ISSUE 2): the whole split body is labeled in
-        # HLO metadata so profile_dir= traces group the per-split ops
-        with jax.named_scope("leafwise_split"):
-            return jax.lax.cond(should_split, do_split, no_split, state)
-
-    count = L - 1 if loop_count is None else loop_count
-    state = jax.lax.fori_loop(0, count, body, state)
-    return state if return_state else state.tree
-
-
-_GROW_STATICS = ("num_leaves", "num_bins_max", "min_data_in_leaf",
-                 "min_sum_hessian_in_leaf", "max_depth", "hist_backend",
-                 "hist_chunk", "compute_dtype", "packing")
-
-
-@functools.partial(jax.jit, static_argnames=_GROW_STATICS)
-def _grow_init(bins, grad, hess, row_mask, feature_mask, num_bins,
-               **kwargs) -> _GrowState:
-    return grow_tree_impl(bins, grad, hess, row_mask, feature_mask,
-                          num_bins, loop_count=0, return_state=True,
-                          **kwargs)
-
-
-# donate the carried state: without aliasing, input and output copies of
-# hist_cache [L,F,B,3] + leaf_ids [N] (~120 MB at bench scale) would both
-# be live at every segment boundary
-@functools.partial(jax.jit, static_argnames=_GROW_STATICS + ("loop_count",),
-                   donate_argnums=(6,))
-def _grow_segment(bins, grad, hess, row_mask, feature_mask, num_bins,
-                  state, *, loop_count, **kwargs) -> _GrowState:
-    return grow_tree_impl(bins, grad, hess, row_mask, feature_mask,
-                          num_bins, init_state=state,
-                          loop_count=loop_count, return_state=True,
-                          **kwargs)
-
-
-def grow_tree_segmented(bins, grad, hess, row_mask, feature_mask, num_bins,
-                        *, segments: int, **kwargs) -> TreeArrays:
-    """grow_tree split across ``segments`` device dispatches.
-
-    A 255-leaf leaf-wise tree is 254 sequential full-data histogram passes
-    in ONE XLA dispatch; at tens of millions of rows that single dispatch
-    can run minutes (and trips this environment's ~60 s per-dispatch
-    execution watchdog, BASELINE.md).  The split loop's body never reads
-    the loop index, so running fori_loop(0, L-1) as ceil((L-1)/segments)-
-    sized pieces with the _GrowState carried device-resident between
-    dispatches is program-identical — same trees, bit for bit.  Equal-size
-    segments share one compiled program (the count, not the start, is the
-    static).
-    """
-    L = kwargs["num_leaves"]
-    total = max(L - 1, 1)
-    per = -(-total // max(segments, 1))
-    state = _grow_init(bins, grad, hess, row_mask, feature_mask, num_bins,
-                       **kwargs)
-    done = 0
-    while done < total:
-        n = min(per, total - done)
-        state = _grow_segment(bins, grad, hess, row_mask, feature_mask,
-                              num_bins, state, loop_count=n, **kwargs)
-        done += n
-    return state.tree
+    """Historical keyword-seam surface over
+    ``grow_tree_unified(policy="leafwise")`` — the individual seam kwargs
+    assemble into one SeamSchedule."""
+    schedule = SeamSchedule(
+        hist_axis=hist_axis, hist_reduce=hist_reduce,
+        int_hist_reduce=int_hist_reduce, stat_reduce=stat_reduce,
+        root_hist_reduce=root_hist_reduce, own_slice=own_slice,
+        split_finder=split_finder)
+    return grow_tree_unified(
+        bins, grad, hess, row_mask, feature_mask, num_bins,
+        policy="leafwise", num_leaves=num_leaves,
+        num_bins_max=num_bins_max, min_data_in_leaf=min_data_in_leaf,
+        min_sum_hessian_in_leaf=min_sum_hessian_in_leaf,
+        max_depth=max_depth, hist_backend=hist_backend,
+        hist_chunk=hist_chunk, compute_dtype=compute_dtype,
+        packing=packing, schedule=schedule, partition_bins=partition_bins,
+        init_state=init_state, loop_count=loop_count,
+        return_state=return_state)

@@ -1,68 +1,28 @@
-"""Depth-wise (level-batched) tree grower — the TPU throughput path.
+"""Depth-wise grower — compat shim over ``models/grower_unified.py``.
 
-The reference grows leaf-wise: one histogram rebuild per split, 254
-sequential device passes for a 255-leaf tree
-(/root/reference/src/treelearner/serial_tree_learner.cpp:119-153).  That
-schedule is hostile to a systolic-array machine: each pass is a matmul whose
-value operand has only 3 columns (grad/hess/count), so the MXU runs ~2% full
-and per-pass fixed costs are paid 254 times.
-
-This grower instead grows the tree LEVEL by level (XGBoost-style
-``grow_policy=depthwise``) and builds the histograms of ALL leaves of a
-level in ONE leaf-batched matmul pass (ops/histogram.py
-``histogram_leafbatch``): the value operand gets 3·P columns for P parent
-slots, filling the MXU.  A 255-leaf tree needs 8 batched passes instead of
-254 single-leaf passes.  The smaller-child + subtraction trick
-(serial_tree_learner.cpp:262-283, feature_histogram.hpp:91-100) is kept at
-level granularity: each level histograms only the SMALLER child of every
-split parent and derives the sibling by parent − smaller.
-
-Semantics: identical split-finding math as the leaf-wise grower (same
-``find_best_split``), but split ORDER is by level, not globally best-first —
-a deliberate, documented TPU-first trade (the reference's strict leaf-wise
-order remains available as ``grow_policy=leafwise``).  The ``num_leaves``
-budget is honored exactly: when a level has more splittable leaves than
-budget, the top leaves by gain are split (mirroring best-first within the
-level); trees therefore have at most ``num_leaves`` leaves, at depth
-``ceil(log2(num_leaves))`` (or ``max_depth``).
-
-The whole tree is ONE jitted straight-line XLA program (levels unrolled in
-Python — every level has static shapes [P = 2^d slots]), with no
-data-dependent host round-trips.
+The three grower modules were collapsed into ONE schedule-parameterized
+grower (ISSUE 9); this module keeps the historical depth-wise entry
+points (``grow_tree_depthwise`` with keyword seams, the module-level
+``grow_tree_depthwise_jit``, ``num_levels``).  New code should import
+from ``grower_unified`` directly.
 """
 from __future__ import annotations
 
-import functools
-import math
-
-import jax
 import jax.numpy as jnp
 
-from .. import telemetry
-from ..ops.histogram import histogram_leafbatch
-from ..ops.split import find_best_split
-from .grower import TreeArrays
+# patchable histogram seam: tests and scripts/profile_phases.py
+# monkeypatch THIS attribute (the unified grower resolves it through
+# this module at trace time)
+from ..ops.histogram import histogram_leafbatch  # noqa: F401
 
-# out-of-bounds scatter index → mode="drop".  A plain int, NOT jnp.int32:
-# creating a jax array at import time would initialize the XLA backend
-# before jax.distributed.initialize can run (multi-process bootstrap).
-BIG = 1 << 28
-
-
-def num_levels(num_leaves: int, max_depth: int = -1) -> int:
-    """Number of split levels.  Matches the leaf-wise depth rule
-    (grower.py: a leaf at depth >= max_depth cannot split, root depth 1), so
-    max_depth allows max_depth - 1 split levels."""
-    d = max(1, math.ceil(math.log2(max(num_leaves, 2))))
-    if max_depth > 0:
-        d = min(d, max(max_depth - 1, 1))
-    return d
+from .grower_unified import (  # noqa: F401
+    BIG, SeamSchedule, TreeArrays, grow_tree_depthwise_jit,
+    grow_tree_unified, num_levels)
 
 
-def grow_tree_depthwise(bins: jax.Array, grad: jax.Array, hess: jax.Array,
-                        row_mask: jax.Array, feature_mask: jax.Array,
-                        num_bins: jax.Array, *, num_leaves: int,
-                        num_bins_max: int, min_data_in_leaf: int,
+def grow_tree_depthwise(bins, grad, hess, row_mask, feature_mask,
+                        num_bins, *, num_leaves: int, num_bins_max: int,
+                        min_data_in_leaf: int,
                         min_sum_hessian_in_leaf: float, max_depth: int = -1,
                         hist_chunk: int = 65536, hist_reduce=None,
                         stat_reduce=None, split_finder=None,
@@ -70,336 +30,18 @@ def grow_tree_depthwise(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                         compute_dtype=jnp.float32, packing=None,
                         hist_reduce_level=None, int_reduce_level=None,
                         own_slice=None) -> TreeArrays:
-    """Grow one depth-wise tree.  Output contract == grow_tree_impl's
-    TreeArrays (models/grower.py), so boosting/serialization/prediction are
-    policy-agnostic.
-
-    hist_reduce/stat_reduce: collective hooks for the data-parallel learner
-    (psum over the mesh), applied to the [C,F,B,3] level histogram and the
-    root stat triple respectively.
-    split_finder: optional replacement for find_best_split; the
-    feature-parallel learner wraps it with the SplitInfo argmax allreduce and
-    must return GLOBAL feature indices (vmapped over level slots, so any
-    collectives inside are batched).
-    partition_bins: optional [F_global, N] matrix used to APPLY splits when
-    ``bins`` is only the owned feature slice (feature-parallel).
-
-    ReduceScatter ownership schedule (the reference's bandwidth-optimal
-    data-parallel plan, data_parallel_tree_learner.cpp:135-235): the ROOT
-    pass reduces in full (root stats must be the replicated global triple),
-    ``own_slice`` then cuts each shard's contiguous feature block out of
-    the replicated root histogram, and every deeper level reduces via
-    ``hist_reduce_level`` (f32: psum_scatter on the feature axis) or
-    ``int_reduce_level`` (int8: psum_scatter of the INT accumulators,
-    preserving the bit-exactness chain).  ``split_finder`` must then map
-    block-local feature ids to global and allreduce the SplitInfo; the
-    subtraction trick works unchanged on owned blocks.
-    """
-    F, N = bins.shape
-    L = num_leaves
-    D = num_levels(L, max_depth)
-    B = num_bins_max
-    f32 = jnp.float32
-    i32 = jnp.int32
-
-    # wire-metrics hook point (ISSUE 5): label any seam the learner did
-    # not already wrap (collective_span passes wrapped fns through); the
-    # level reducers trace once per level, so loop stays 1 per trace
-    from .. import telemetry as _tl
-    hist_reduce = _tl.collective_span(
-        "depthwise/hist_reduce", hist_reduce, kind="reduce",
-        axis=hist_axis, phase="grow")
-    hist_reduce_level = _tl.collective_span(
-        "depthwise/level_hist_reduce", hist_reduce_level, kind="reduce",
-        axis=hist_axis, phase="grow")
-    int_reduce_level = _tl.collective_span(
-        "depthwise/level_int_reduce", int_reduce_level, kind="reduce",
-        axis=hist_axis, phase="grow")
-    stat_reduce = _tl.collective_span(
-        "depthwise/root_stats", stat_reduce, kind="reduce", axis=hist_axis,
-        phase="grow")
-
-    maskf = row_mask.astype(f32)
-    mind = float(min_data_in_leaf)
-    minh = float(min_sum_hessian_in_leaf)
-
-    def batch_hist_rows(b, g, h, col_id, col_ok, C, level=False, salt=0):
-        # level passes may use the scatter schedule; the root pass always
-        # reduces in full
-        int_red = int_reduce_level if level else None
-        # forward optional kwargs only when set: drop-in replacements
-        # (histogram_leafbatch_segsum, test/profiling stubs) don't take
-        # them
-        extra = {"int_reduce": int_red} if int_red is not None else {}
-        if salt and compute_dtype == "int8_sr":
-            extra["salt"] = salt
-        out = histogram_leafbatch(b, g, h, col_id, col_ok, C, B,
-                                  chunk=hist_chunk,
-                                  compute_dtype=compute_dtype,
-                                  axis_name=hist_axis,
-                                  **({"packing": packing}
-                                     if packing is not None else {}),
-                                  **extra)
-        # the quantized path reduces its INT accumulators internally over
-        # hist_axis (bit-exactness); applying hist_reduce again would
-        # double-count
-        if str(compute_dtype).startswith("int8") and hist_axis is not None:
-            return out
-        red = (hist_reduce_level or hist_reduce) if level else hist_reduce
-        if red is not None:
-            out = red(out)
-        return out
-
-    def batch_hist(col_id, col_ok, C, level=False, salt=0):
-        return batch_hist_rows(bins, grad, hess, col_id, col_ok, C,
-                               level=level, salt=salt)
-
-    vsplit = jax.vmap(split_finder or find_best_split,
-                      in_axes=(0, 0, 0, 0, None, None, None, None))
-    if partition_bins is None:
-        partition_bins = bins
-
-    # ---- root (BeforeTrain: serial_tree_learner.cpp:155-236).
-    # named_scope per level (ISSUE 2): profile_dir= Perfetto traces show
-    # the unrolled level structure ("level0/histogram", ...) instead of a
-    # flat op soup — unconditional, so it can't perturb program identity
-    with jax.named_scope("level0"):
-        hists = batch_hist(jnp.zeros((N,), i32), row_mask, 1)  # [1,F,B,3]
-    if str(compute_dtype).startswith("int8"):
-        # derive root stats from the root histogram: the quantized hist is
-        # bit-identical across serial / data-parallel / multi-process (the
-        # scale is pmax-synced and int32 sums are order-free), so this
-        # makes the WHOLE tree's stat chain reduction-order-free — a row
-        # psum here would differ from a serial row sum by ulps and flip
-        # near-tie splits between serial and distributed runs.  (Also keeps
-        # parent == left + right exactly in quantized space.)
-        root_stats = jnp.sum(hists[0, 0], axis=0)          # [3]
-    else:
-        root_stats = jnp.stack([jnp.sum(grad * maskf),
-                                jnp.sum(hess * maskf), jnp.sum(maskf)])
-        if stat_reduce is not None:
-            root_stats = stat_reduce(root_stats)
-    if own_slice is not None:
-        # ownership schedule: keep only this shard's contiguous feature
-        # block from here on (root stats above came from the full
-        # replicated histogram, so they stay bit-identical to the psum
-        # schedule)
-        hists = own_slice(hists)
-
-    # per-slot level state (slot s at level d holds one candidate leaf)
-    alive = jnp.ones((1,), bool)
-    leaf_of = jnp.zeros((1,), i32)          # output leaf index per slot
-    parent_node = jnp.full((1,), -1, i32)   # node owning this slot's leaf
-    slot_g = root_stats[0][None]
-    slot_h = root_stats[1][None]
-    slot_c = root_stats[2][None]
-
-    slot_id = jnp.zeros((N,), i32)          # row → level-local slot
-    out_leaf = jnp.zeros((N,), i32)         # row → output leaf index
-
-    # output tree arrays (static size L)
-    leaf_value = jnp.zeros((L,), f32)
-    leaf_count = jnp.zeros((L,), i32).at[0].set(root_stats[2].astype(i32))
-    leaf_parent = jnp.full((L,), -1, i32)
-    split_feature = jnp.zeros((max(L - 1, 1),), i32)
-    threshold_bin = jnp.zeros((max(L - 1, 1),), i32)
-    split_gain = jnp.zeros((max(L - 1, 1),), f32)
-    left_child = jnp.zeros((max(L - 1, 1),), i32)
-    right_child = jnp.zeros((max(L - 1, 1),), i32)
-
-    n_nodes = jnp.asarray(0, i32)           # == num_leaves_cur - 1
-
-    for d in range(D):
-        P = 1 << d
-
-        # ---- best split per slot (vmapped FindBestThreshold scan).  The
-        # span wraps the CALL (not the vmapped body — a batching trace is
-        # never "execution"), so eager runs (jax.disable_jit telemetry
-        # profiling) attribute real split-search time
-        with telemetry.span("split_find") as _sp:
-            res = _sp.fence(vsplit(hists, slot_g, slot_h, slot_c, num_bins,
-                                   feature_mask, mind, minh))
-        can = alive & (res.gain > 0.0) & jnp.isfinite(res.gain)
-
-        # ---- budget: split the top-gain slots first (within-level
-        # best-first, matching the leaf-wise selection rule at level scope)
-        budget = (L - 1) - n_nodes
-        gains_m = jnp.where(can, res.gain, -jnp.inf)
-        order = jnp.argsort(-gains_m)                 # best slot first
-        rank = jnp.argsort(order).astype(i32)         # slot → rank
-        chosen = can & (rank < budget)
-        n_chosen = jnp.sum(chosen.astype(i32))
-
-        # ---- index assignment, in slot order (deterministic)
-        csum = jnp.cumsum(chosen.astype(i32))
-        node_of = n_nodes + csum - 1                  # node per chosen slot
-        right_leaf = (n_nodes + 1) + csum - 1         # new leaf per chosen
-        bl = leaf_of
-
-        nidx = jnp.where(chosen, node_of, BIG)
-        blx = jnp.where(chosen, bl, BIG)
-        rlx = jnp.where(chosen, right_leaf, BIG)
-
-        # ---- node records (Tree::Split, tree.cpp:50-83)
-        split_feature = split_feature.at[nidx].set(res.feature, mode="drop")
-        threshold_bin = threshold_bin.at[nidx].set(res.threshold, mode="drop")
-        split_gain = split_gain.at[nidx].set(res.gain, mode="drop")
-        left_child = left_child.at[nidx].set(~bl, mode="drop")
-        right_child = right_child.at[nidx].set(~right_leaf, mode="drop")
-
-        # parent child-pointer fixup: slot parity says which side this
-        # slot's leaf sits on in its parent node (even = left)
-        pfix = jnp.where(chosen & (parent_node >= 0), parent_node, BIG)
-        if d > 0:
-            is_left = (jnp.arange(P, dtype=i32) % 2) == 0
-            left_child = left_child.at[
-                jnp.where(is_left, pfix, BIG)].set(node_of, mode="drop")
-            right_child = right_child.at[
-                jnp.where(is_left, BIG, pfix)].set(node_of, mode="drop")
-
-        # ---- leaf records
-        leaf_value = leaf_value.at[blx].set(res.left_output, mode="drop")
-        leaf_value = leaf_value.at[rlx].set(res.right_output, mode="drop")
-        leaf_count = leaf_count.at[blx].set(res.left_count, mode="drop")
-        leaf_count = leaf_count.at[rlx].set(res.right_count, mode="drop")
-        leaf_parent = leaf_parent.at[blx].set(node_of, mode="drop")
-        leaf_parent = leaf_parent.at[rlx].set(node_of, mode="drop")
-
-        n_nodes = n_nodes + n_chosen
-
-        # ---- partition rows (DataPartition::Split as fused masked passes)
-        # All per-slot attributes a row needs (split feature, threshold,
-        # chosen flag, new right-leaf id, smaller-child side) ride ONE
-        # [P, N] one-hot matmul instead of one pass per attribute: the
-        # slot-select one-hot is the expensive object (O(P·N) comparisons),
-        # so it is generated once and contracted against a packed [P, K]
-        # table.
-        small_is_right = res.right_count < res.left_count        # ties → left
-        with telemetry.span("partition") as _sp:
-            # mixed-bin packing stores the matrix rows in packed order;
-            # the per-slot partition feature must address that layout
-            # (the recorded split_feature above stays canonical)
-            feat_part = res.feature
-            if packing is not None and len(packing.widths) > 1:
-                feat_part = jnp.asarray(packing.c2p, jnp.int32)[res.feature]
-            table = jnp.stack([feat_part.astype(f32),
-                               res.threshold.astype(f32),
-                               chosen.astype(f32),
-                               right_leaf.astype(f32),
-                               small_is_right.astype(f32)], axis=1)  # [P, 5]
-            lsel = (slot_id[None, :] ==
-                    jnp.arange(P, dtype=i32)[:, None]).astype(f32)   # [P, N]
-            # The table carries integer ids (feature, threshold, leaf).
-            # Default TPU matmul precision truncates f32 operands to bf16,
-            # which is EXACT for integers <= 256 — and exactly one lsel
-            # entry matches per row, so there is no accumulation error
-            # either.  Only configs with ids beyond 256 need the 6-pass
-            # HIGHEST decomposition (measured 2.27 ms vs 0.72 ms per level
-            # at 11M rows).
-            ids_bf16_exact = max(F, B, L) <= 256
-            attr_prec = (None if ids_bf16_exact
-                         else jax.lax.Precision.HIGHEST)
-            attrs = jnp.einsum("pn,pk->kn", lsel, table,
-                               precision=attr_prec,
-                               preferred_element_type=jnp.float32)   # [5, N]
-            feat_row = attrs[0].astype(i32)
-            thr_row = attrs[1].astype(i32)
-            in_chosen = attrs[2] > 0.5
-            rl_row = attrs[3].astype(i32)
-            small_right_row = attrs[4] > 0.5
-
-            # the row's bin on its slot's split feature: an O(F·N) feature
-            # one-hot avoids materializing the old [P, N] row gather, but
-            # its cost grows with the dataset width — for wide datasets a
-            # direct per-row gather is cheaper than F·N comparisons
-            Fg = partition_bins.shape[0]
-            if Fg <= 128:
-                fsel = (feat_row[None, :]
-                        == jnp.arange(Fg, dtype=i32)[:, None])
-                # bins < 256 are bf16-exact and one fsel entry matches per
-                # row
-                row_bin = jnp.einsum(
-                    "fn,fn->n", fsel.astype(f32), partition_bins.astype(f32),
-                    precision=(None if B <= 256
-                               else jax.lax.Precision.HIGHEST)).astype(i32)
-            else:
-                row_bin = jnp.take_along_axis(
-                    partition_bins, feat_row[None, :], axis=0)[0].astype(i32)
-            go_right = row_bin > thr_row
-            out_leaf = jnp.where(in_chosen & go_right, rl_row, out_leaf)
-            slot_id = (2 * slot_id
-                       + jnp.where(in_chosen, go_right.astype(i32), 0))
-            _sp.fence((out_leaf, slot_id))
-
-        if d + 1 >= D:
-            break
-
-        # ---- next-level slot state (children of slot s at 2s / 2s+1)
-        def interleave(a, b):
-            return jnp.stack([a, b], axis=1).reshape(2 * P, *a.shape[1:])
-
-        alive = interleave(chosen, chosen)
-        leaf_of = interleave(bl, right_leaf)
-        parent_node = interleave(node_of, node_of)
-        slot_g = interleave(res.left_sum_grad, res.right_sum_grad)
-        slot_h = interleave(res.left_sum_hess, res.right_sum_hess)
-        slot_c = interleave(res.left_count.astype(f32),
-                            res.right_count.astype(f32))
-
-        # ---- level histogram: build ONLY the smaller child of every chosen
-        # parent in one batched pass, derive the sibling by subtraction
-        par_of_row = slot_id // 2
-        # Smaller-child choice from the SplitResult counts (integer-valued
-        # f32 histogram sums; replicated under the data-parallel learner,
-        # whose counts come from psum'd histograms).  Above 2^24 rows per
-        # node the f32 rounding could mis-order near-equal children — that
-        # only means the pass histograms the slightly larger child (the
-        # sibling is still exact via subtraction), a perf non-event, so no
-        # recount is needed at any scale.
-        sel = in_chosen & (go_right == small_right_row) & row_mask
-        # The masked full-N pass is the fastest smaller-child schedule
-        # measured on v5e (1M and 11M rows): gathering the selected rows
-        # into a compact N/2 buffer first (the masked-dense analog of the
-        # reference's per-leaf index lists, data_partition.hpp) costs more
-        # in cumsum/scatter/gather plumbing than the halved histogram pass
-        # saves — see git history for the removed compaction path.
-        with jax.named_scope("level%d" % (d + 1)):
-            hist_small = batch_hist(par_of_row, sel, P, level=True,
-                                    salt=d + 1)
-        hist_large = hists - hist_small
-        hsmall_slot = interleave(jnp.where(small_is_right[:, None, None, None],
-                                           hist_large, hist_small),
-                                 jnp.where(small_is_right[:, None, None, None],
-                                           hist_small, hist_large))
-        hists = hsmall_slot
-
-    num_leaves_final = n_nodes + 1
-    return TreeArrays(
-        num_leaves=num_leaves_final,
-        split_feature=split_feature[:max(L - 1, 1)],
-        threshold_bin=threshold_bin,
-        split_gain=split_gain,
-        left_child=left_child,
-        right_child=right_child,
-        leaf_parent=leaf_parent,
-        leaf_value=leaf_value,
-        leaf_count=leaf_count,
-        leaf_ids=out_leaf,
-    )
-
-
-# Module-level jit so repeated boosters with identical shapes/config share
-# one compiled program (the unrolled level program takes minutes to compile).
-# Wrapped in the cost registry (costmodel.instrument): with telemetry armed
-# the compiled program self-reports cost_analysis + compile seconds for the
-# roofline/compile blocks.
-from .. import costmodel as _costmodel  # noqa: E402
-
-grow_tree_depthwise_jit = _costmodel.instrument(
-    "grow/depthwise",
-    jax.jit(grow_tree_depthwise,
-            static_argnames=("num_leaves", "num_bins_max",
-                             "min_data_in_leaf", "min_sum_hessian_in_leaf",
-                             "max_depth", "hist_chunk", "compute_dtype",
-                             "packing", "hist_axis")),
-    phase="grow")
+    """Historical keyword-seam surface over
+    ``grow_tree_unified(policy="depthwise")``."""
+    schedule = SeamSchedule(
+        hist_axis=hist_axis, hist_reduce=hist_reduce,
+        stat_reduce=stat_reduce, own_slice=own_slice,
+        split_finder=split_finder, hist_reduce_level=hist_reduce_level,
+        int_reduce_level=int_reduce_level)
+    return grow_tree_unified(
+        bins, grad, hess, row_mask, feature_mask, num_bins,
+        policy="depthwise", num_leaves=num_leaves,
+        num_bins_max=num_bins_max, min_data_in_leaf=min_data_in_leaf,
+        min_sum_hessian_in_leaf=min_sum_hessian_in_leaf,
+        max_depth=max_depth, hist_chunk=hist_chunk,
+        compute_dtype=compute_dtype, packing=packing, schedule=schedule,
+        partition_bins=partition_bins)

@@ -1,141 +1,23 @@
-"""Compacted leaf-wise grower — reference-parity growth at reference-like
-cost.
+"""Compacted leaf-wise grower — compat shim over
+``models/grower_unified.py``.
 
-The plain leaf-wise grower (grower.py) sweeps ALL N rows for every one of
-the num_leaves-1 histogram passes, because its DataPartition is a [N]
-leaf-id vector and the smaller child is selected by a mask.  The reference
-never does that: DataPartition keeps each leaf's rows contiguous in a
-permuted index array (data_partition.hpp:93-139) and ConstructHistogram
-walks only the leaf's own rows (serial_tree_learner.cpp:262-283,
-dense_bin.hpp:46-112), so total per-tree histogram work is the
-geometric-series sum of smaller-child sizes (~N·log L), not N·(L-1).
-
-This grower restores that asymptotic on TPU terms.  Indices can't be
-followed on a TPU (XLA gathers at 11M rows lower to per-row scalar
-addressing — PROFILE.md's measured dead end), so the DATA is kept
-physically partitioned instead: one [F+9, P] int8 "plane pane" (bin rows,
-grad/hess as f32 bit-planes, validity) in which every leaf owns a
-contiguous lane range.  Each split
-
-1. stably partitions the parent's range in a streaming sweep
-   (ops/compact.py — Pallas MXU selection-matmul kernel on TPU, stable
-   argsort oracle elsewhere), and
-2. histograms ONLY the physically-smaller child's range, deriving the
-   sibling by parent-minus-smaller subtraction exactly as before.
-
-jit needs static shapes, so ranges are sliced at bucketed widths
-(ops/compact.bucket_table: halving block-rounded tiers); a lax.switch over
-the parent's tier picks the compiled width, and lane masks handle the
-bucket slack.  The child histogram runs over the parent's own partitioned
-segment with the child's lane range masked — per-split cost is the parent
-tier's width, whose sum over the tree is the geometric series (~N·log L),
-not N·(L-1).
-
-Equivalence to grower.grow_tree: the partition is stable, so the smaller
-child's rows are visited in the same relative order as the masked
-full-data pass (whose non-member lanes contribute exact +0.0 terms); the
-directly-built child follows the masked grower's valid-smaller rule, so
-direct/subtracted rounding matches too.  Measured caveat (tests/
-test_leafcompact.py): on XLA **CPU** the int8 path's dequantize multiply
-gets contracted into the parent-minus-smaller subtraction as a
-single-rounding FMA in SOME program contexts — sub-ulp dust that neither
-``lax.optimization_barrier`` nor a bitcast round-trip nor
-``reduce_precision`` suppresses (all verified ignored by the fusion
-pipeline).  This grower matches a jit-free replay of the identical ops
-BIT FOR BIT (the masked grower is the one carrying the FMA dust there);
-int8 CPU cross-grower comparisons are therefore structure-exact but
-value-tolerant, while f32 histograms (no trailing dequantize multiply)
-and the TPU paths are bit-identical across growers.
-
-Runs under the serial learner AND the data-parallel learner's BOTH
-histogram-reduction schedules (parallel/learners.DataParallelLearner):
-each shard keeps its LOCAL rows physically partitioned, and the
-per-split smaller-child histograms are either psum'd whole
-(``dp_schedule=psum``) or psum_scatter'd by contiguous feature block
-with an owned-feature search + packed SplitInfo allreduce
-(``reduce_scatter`` — the reference's N-machine ownership schedule,
-data_parallel_tree_learner.cpp:135-235, in its native growth order).
-The hist_reduce/int_hist_reduce/split_finder/own_slice seams below
-carry both; the histogram slice tier is pmax-synchronized so the
-collectives inside the tier switch stay uniform across shards.
+The three grower modules were collapsed into ONE schedule-parameterized
+grower (ISSUE 9); this module keeps the historical compact entry points
+(``grow_tree_leafcompact_impl`` with keyword seams, the module-level
+``grow_tree_leafcompact``).  New code should import from
+``grower_unified`` directly.
 """
 from __future__ import annotations
 
-from typing import NamedTuple
-
-import jax
 import jax.numpy as jnp
 
-from ..ops.compact import (BLOCK, bucket_table, pack_planes, pane_rows,
-                           partition_segment, unpack_values)
-from ..ops.histogram import build_histogram
-from .grower import TreeArrays
-from ..ops.split import find_best_split
+# patchable histogram seam (the unified grower resolves it through this
+# module at trace time)
+from ..ops.histogram import build_histogram  # noqa: F401
 
-
-class _CompactState(NamedTuple):
-    tree: TreeArrays
-    pane: jax.Array             # [F+9, P] int8 — partitioned plane pane
-    seg_start: jax.Array        # [L] i32 — leaf -> lane range start
-    seg_cnt: jax.Array          # [L] i32 — physical lane count
-    seg_bucket: jax.Array       # [L] i32 — static width tier
-    hist_cache: jax.Array       # [L, F, B, 3] (owned Fb block under the
-                                # reduce_scatter ownership schedule)
-    cand_gain: jax.Array        # [L]
-    cand_feature: jax.Array
-    cand_threshold: jax.Array
-    cand_left_out: jax.Array
-    cand_right_out: jax.Array
-    cand_left_cnt: jax.Array
-    cand_right_cnt: jax.Array
-    cand_left_g: jax.Array
-    cand_left_h: jax.Array
-    cand_right_g: jax.Array
-    cand_right_h: jax.Array
-    leaf_depth: jax.Array       # [L] i32
-    done: jax.Array             # bool
-
-
-def _grow_tree_leafcompact_fn(bins, grad, hess, row_mask, feature_mask,
-                              num_bins, *, num_leaves: int,
-                              num_bins_max: int,
-                              min_data_in_leaf: int,
-                              min_sum_hessian_in_leaf: float,
-                              max_depth: int = -1,
-                              hist_backend: str = "matmul",
-                              hist_chunk: int = 16384,
-                              compute_dtype=jnp.float32,
-                              packing=None,
-                              use_pallas_partition: bool = False,
-                              partition_overlap: bool = True,
-                              interpret: bool = False) -> TreeArrays:
-    return grow_tree_leafcompact_impl(
-        bins, grad, hess, row_mask, feature_mask, num_bins,
-        num_leaves=num_leaves, num_bins_max=num_bins_max,
-        min_data_in_leaf=min_data_in_leaf,
-        min_sum_hessian_in_leaf=min_sum_hessian_in_leaf,
-        max_depth=max_depth, hist_backend=hist_backend,
-        hist_chunk=hist_chunk, compute_dtype=compute_dtype,
-        packing=packing,
-        use_pallas_partition=use_pallas_partition,
-        partition_overlap=partition_overlap, interpret=interpret)
-
-
-# module-level jit wrapped in the cost registry (costmodel.instrument) so
-# the compacted grower's compiled programs self-report cost_analysis +
-# compile seconds to the roofline/compile blocks when telemetry is armed
-from .. import costmodel as _costmodel  # noqa: E402
-
-grow_tree_leafcompact = _costmodel.instrument(
-    "grow/leafcompact",
-    jax.jit(_grow_tree_leafcompact_fn,
-            static_argnames=("num_leaves", "num_bins_max",
-                             "min_data_in_leaf", "min_sum_hessian_in_leaf",
-                             "max_depth", "hist_backend", "hist_chunk",
-                             "compute_dtype", "packing",
-                             "use_pallas_partition",
-                             "partition_overlap", "interpret")),
-    phase="grow")
+from .grower_unified import (  # noqa: F401
+    SeamSchedule, TreeArrays, _CompactState, grow_tree_leafcompact,
+    grow_tree_unified)
 
 
 def grow_tree_leafcompact_impl(bins, grad, hess, row_mask, feature_mask,
@@ -155,386 +37,21 @@ def grow_tree_leafcompact_impl(bins, grad, hess, row_mask, feature_mask,
                                stat_reduce=None, own_slice=None,
                                root_hist_reduce=None,
                                return_state: bool = False):
-    """Core (not jitted; callers wrap it).  ``return_state`` exposes the
-    full _CompactState for differential debugging against
-    grower.grow_tree_impl's state.
-
-    hist_reduce/hist_axis/stat_reduce: the data-parallel (psum) seams,
-    same contract as grower.grow_tree_impl — each shard keeps its LOCAL
-    rows physically partitioned and the per-split histograms are reduced
-    globally.  Collectives may not sit inside per-shard-divergent
-    control flow, so the per-split work is TWO switches: the partition
-    switch (local, collective-free — each shard picks its own tier) and
-    the histogram switch, whose tier selector is pmax-synchronized
-    across shards (every shard takes the same branch, so the psum
-    inside it lines up).
-
-    int_hist_reduce/split_finder/own_slice/root_hist_reduce: the
-    reduce_scatter OWNERSHIP seams, same contract as
-    grower.grow_tree_impl — hist_reduce becomes a feature-block
-    psum_scatter (int_hist_reduce its int-domain twin for the quantized
-    path), so every per-split histogram and the hist cache hold only
-    this shard's OWNED block; split_finder must then be the owned-search
-    + SplitInfo-allreduce composite returning GLOBAL feature indices,
-    and feature_mask/num_bins the owned slices
-    (learners.DataParallelLearner._compact_grow_fn).  The root is built
-    replicated at full F (root_hist_reduce, then own_slice caches the
-    owned block) so root stats stay exact on feature-padding shards.
-    The PANE keeps all F features either way — the winning feature is
-    global, and partitioning needs its bin row."""
-    F, N = bins.shape
-    R = pane_rows(F)            # plane-pane rows (ops/compact.pack_planes)
-    L = num_leaves
-    B = num_bins_max
-    f32 = jnp.float32
-    # wire-metrics hook point (ISSUE 5): label any seam the learner did
-    # not already wrap (collective_span passes wrapped fns through)
-    from .. import telemetry as _tl
-    hist_reduce = _tl.collective_span(
-        "leafcompact/hist_reduce", hist_reduce, kind="reduce",
-        axis=hist_axis, loop=L - 1, phase="grow")
-    int_hist_reduce = _tl.collective_span(
-        "leafcompact/int_hist_reduce", int_hist_reduce, kind="reduce",
-        axis=hist_axis, loop=L - 1, phase="grow")
-    stat_reduce = _tl.collective_span(
-        "leafcompact/root_stats", stat_reduce, kind="reduce",
-        axis=hist_axis, phase="grow")
-    root_hist_reduce = _tl.collective_span(
-        "leafcompact/root_hist", root_hist_reduce, kind="reduce",
-        axis=hist_axis, phase="grow")
-    c2p_arr = (jnp.asarray(packing.c2p, jnp.int32)
-               if packing is not None and len(packing.widths) > 1 else None)
-    table = bucket_table(N, min_width=max(BLOCK, (-(-N // BLOCK) * BLOCK)
-                                          >> 9))
-    P = table[0]
-    K = len(table)
-    table_arr = jnp.asarray(table, jnp.int32)
-
-    def bucket_of(x):
-        return (jnp.sum(table_arr >= jnp.maximum(x, 1)) - 1).astype(
-            jnp.int32)
-
-    def hist_of(hbins, hg, hh, hmask, salt=0):
-        hist = build_histogram(hbins, hg, hh, hmask, B,
-                               backend=hist_backend, chunk=hist_chunk,
-                               compute_dtype=compute_dtype,
-                               axis_name=hist_axis,
-                               int_reduce=int_hist_reduce, salt=salt,
-                               packing=packing)
-        # the quantized path reduces its INT accumulators internally over
-        # hist_axis (grower.grow_tree_impl's rule, kept identical) — psum
-        # by default, the ownership feature-block scatter when
-        # int_hist_reduce is set
-        if hist_reduce is not None and not (
-                str(compute_dtype).startswith("int8")
-                and hist_axis is not None):
-            hist = hist_reduce(hist)
-        return hist
-
-    finder = split_finder or find_best_split
-
-    def _finder(hist, sum_g, sum_h, cnt):
-        return finder(hist, sum_g, sum_h, cnt, num_bins,
-                      feature_mask, float(min_data_in_leaf),
-                      float(min_sum_hessian_in_leaf))
-
-    def _depth_gate(res, depth):
-        if max_depth > 0:
-            res = res._replace(gain=jnp.where(depth >= max_depth,
-                                              -jnp.inf, res.gain))
-        return res
-
-    def best_of(hist, sum_g, sum_h, cnt, depth):
-        return _depth_gate(_finder(hist, sum_g, sum_h, cnt), depth)
-
-    def best_of_pair(lhist, rhist, lg, lh, lc, rg, rh, rc, depth):
-        """Both children's candidate searches in ONE batched finder call
-        (vmap over a [2, F, B, 3] stack): the finder's cumsum/argmax work
-        is tiny, so per-call XLA overhead — paid 2x per split otherwise —
-        is the cost that matters.  Elementwise math is identical to two
-        single calls (both children share the same depth)."""
-        both = _depth_gate(
-            jax.vmap(_finder)(jnp.stack([lhist, rhist]),
-                              jnp.stack([lg, rg]), jnp.stack([lh, rh]),
-                              jnp.stack([lc, rc])), depth)
-        lbest = jax.tree.map(lambda x: x[0], both)
-        rbest = jax.tree.map(lambda x: x[1], both)
-        return lbest, rbest
-
-    # ---- root (BeforeTrain): full-data pass over the ORIGINAL arrays —
-    # identical to grower.grow_tree's root, so the two growers share root
-    # histograms bit for bit
-    if own_slice is not None:
-        # ownership (reduce_scatter) schedule: build the ROOT replicated
-        # — full F, plain psum — so root stats are exact on every shard
-        # including feature-PADDING shards (whose owned block is all
-        # zeros), then cache only the owned slice (grow_tree_impl's rule)
-        full = build_histogram(bins, grad, hess, row_mask, B,
-                               backend=hist_backend, chunk=hist_chunk,
-                               compute_dtype=compute_dtype,
-                               axis_name=hist_axis, packing=packing)
-        if root_hist_reduce is not None and not (
-                str(compute_dtype).startswith("int8")
-                and hist_axis is not None):
-            full = root_hist_reduce(full)
-        root_hist = own_slice(full)
-    else:
-        full = root_hist = hist_of(bins, grad, hess, row_mask)
-    if str(compute_dtype).startswith("int8"):
-        # any single feature's bins sum to the exact quantized totals
-        # (grower.grow_tree's int8 root-stat rule, kept bit-identical;
-        # under the ownership schedule the stats must come from the
-        # replicated full-F root, not the owned block — a feature-padding
-        # shard's block is all zeros)
-        root_stats = jnp.sum(full[0], axis=0)
-    else:
-        maskf = row_mask.astype(f32)
-        root_stats = jnp.stack([jnp.sum(grad * maskf),
-                                jnp.sum(hess * maskf), jnp.sum(maskf)])
-        if stat_reduce is not None:
-            root_stats = stat_reduce(root_stats)
-    root_g, root_h, root_c = root_stats[0], root_stats[1], root_stats[2]
-    root_best = best_of(root_hist, root_g, root_h, root_c,
-                        jnp.asarray(1, jnp.int32))
-
-    neg_inf = jnp.full((L,), -jnp.inf, dtype=f32)
-    zeros_i = jnp.zeros((L,), dtype=jnp.int32)
-    zeros_f = jnp.zeros((L,), dtype=f32)
-
-    tree = TreeArrays(
-        num_leaves=jnp.asarray(1, jnp.int32),
-        split_feature=jnp.zeros((L - 1,), jnp.int32),
-        threshold_bin=jnp.zeros((L - 1,), jnp.int32),
-        split_gain=jnp.zeros((L - 1,), f32),
-        left_child=jnp.zeros((L - 1,), jnp.int32),
-        right_child=jnp.zeros((L - 1,), jnp.int32),
-        leaf_parent=jnp.full((L,), -1, jnp.int32),
-        leaf_value=zeros_f,
-        leaf_count=zeros_i.at[0].set(root_c.astype(jnp.int32)),
-        leaf_ids=jnp.zeros((N,), jnp.int32),
-    )
-    state = _CompactState(
-        tree=tree,
-        pane=pack_planes(bins, grad, hess, row_mask, P),
-        seg_start=zeros_i,
-        seg_cnt=zeros_i.at[0].set(N),
-        seg_bucket=zeros_i.at[0].set(bucket_of(N)),
-        # owned-block shape under the ownership schedule, full F otherwise
-        hist_cache=jnp.zeros((L,) + root_hist.shape, f32).at[0].set(
-            root_hist),
-        cand_gain=neg_inf.at[0].set(root_best.gain),
-        cand_feature=zeros_i.at[0].set(root_best.feature),
-        cand_threshold=zeros_i.at[0].set(root_best.threshold),
-        cand_left_out=zeros_f.at[0].set(root_best.left_output),
-        cand_right_out=zeros_f.at[0].set(root_best.right_output),
-        cand_left_cnt=zeros_i.at[0].set(root_best.left_count),
-        cand_right_cnt=zeros_i.at[0].set(root_best.right_count),
-        cand_left_g=zeros_f.at[0].set(root_best.left_sum_grad),
-        cand_left_h=zeros_f.at[0].set(root_best.left_sum_hess),
-        cand_right_g=zeros_f.at[0].set(root_best.right_sum_grad),
-        cand_right_h=zeros_f.at[0].set(root_best.right_sum_hess),
-        leaf_depth=zeros_i.at[0].set(1),
-        done=jnp.asarray(False),
-    )
-
-    def make_partition_branch(k: int):
-        W = table[k]
-
-        def branch(op):
-            pane, start, cnt, feat, thr = op
-            cs = jnp.minimum(start, P - W)        # clamp: slice stays
-            delta = start - cs                    # in-pane; mask realigns
-            seg = jax.lax.dynamic_slice(pane, (jnp.int32(0), cs), (R, W))
-            pfeat = feat if c2p_arr is None else c2p_arr[feat]
-            fbin = jax.lax.dynamic_index_in_dim(
-                seg[:F], pfeat, axis=0, keepdims=False).astype(jnp.int32)
-            fbin = fbin & 255                     # int8 pane -> uint8 bin
-            lane = jnp.arange(W, dtype=jnp.int32)
-            inseg = (lane >= delta) & (lane < delta + cnt)
-            go_right = fbin > thr
-            mask3 = jnp.where(inseg,
-                              jnp.where(go_right, 0, 1), -1).astype(jnp.int8)
-            plcnt = jnp.sum(inseg & ~go_right).astype(jnp.int32)
-            new_seg = partition_segment(seg, mask3, delta, cnt, plcnt,
-                                        use_pallas=use_pallas_partition,
-                                        overlap=partition_overlap,
-                                        interpret=interpret)
-            pane2 = jax.lax.dynamic_update_slice(pane, new_seg,
-                                                 (jnp.int32(0), cs))
-            return pane2, plcnt
-
-        return branch
-
-    def make_hist_branch(k: int):
-        W = table[k]
-
-        def branch(op):
-            pane2, sstart, scnt, salt = op
-            cs2 = jnp.minimum(sstart, P - W)
-            d2 = sstart - cs2
-            hseg = jax.lax.dynamic_slice(pane2, (jnp.int32(0), cs2),
-                                         (R, W))
-            hbins, hg, hh, hvalid = unpack_values(hseg, F)
-            lane2 = jnp.arange(W, dtype=jnp.int32)
-            hmask = (lane2 >= d2) & (lane2 < d2 + scnt) & hvalid
-            return hist_of(hbins, hg, hh, hmask, salt=salt)
-
-        return branch
-
-    partition_branches = [make_partition_branch(k) for k in range(K)]
-    hist_branches = [make_hist_branch(k) for k in range(K)]
-
-    def body(_, state: _CompactState) -> _CompactState:
-        best_leaf = jnp.argmax(state.cand_gain).astype(jnp.int32)
-        best_gain = state.cand_gain[best_leaf]
-        should_split = jnp.logical_and(~state.done, best_gain > 0.0)
-
-        def do_split(state: _CompactState) -> _CompactState:
-            tree = state.tree
-            bl = best_leaf
-            nl = tree.num_leaves
-            node = nl - 1
-            new_leaf = nl
-
-            feat = state.cand_feature[bl]
-            thr = state.cand_threshold[bl]
-
-            # --- record the node (Tree::Split, tree.cpp:50-83)
-            p = tree.leaf_parent[bl]
-            pp = jnp.maximum(p, 0)
-            lc_at_p = jnp.where((p >= 0) & (tree.left_child[pp] == ~bl),
-                                node, tree.left_child[pp])
-            rc_at_p = jnp.where((p >= 0) & (tree.right_child[pp] == ~bl),
-                                node, tree.right_child[pp])
-            left_child = (tree.left_child.at[pp].set(lc_at_p)
-                          .at[node].set(~bl))
-            right_child = (tree.right_child.at[pp].set(rc_at_p)
-                           .at[node].set(~new_leaf))
-
-            # --- original-order leaf ids (score updates need them; the
-            # pane's permutation never leaves this function)
-            ofeat = feat if c2p_arr is None else c2p_arr[feat]
-            obin = jax.lax.dynamic_index_in_dim(
-                bins, ofeat, axis=0, keepdims=False).astype(jnp.int32)
-            leaf_ids = jnp.where((tree.leaf_ids == bl) & (obin > thr),
-                                 new_leaf, tree.leaf_ids)
-
-            # --- partition the parent's lane range at ITS tier (local,
-            # collective-free: shards may take different branches)
-            start = state.seg_start[bl]
-            cnt = state.seg_cnt[bl]
-            pane2, plcnt = jax.lax.switch(
-                state.seg_bucket[bl], partition_branches,
-                (state.pane, start, cnt, feat, thr))
-            prcnt = cnt - plcnt
-
-            # --- smaller-child histogram at the CHILD's own tier.  The
-            # directly-built side is the VALID-smaller one, exactly like
-            # the masked grower (same direct/subtracted f32 rounding);
-            # its physical span picks the slice tier — pmax-synced across
-            # shards so the collectives inside the branch line up
-            lcnt = state.cand_left_cnt[bl]
-            rcnt = state.cand_right_cnt[bl]
-            left_small = lcnt <= rcnt
-            scnt = jnp.where(left_small, plcnt, prcnt)
-            sstart = jnp.where(left_small, start, start + plcnt)
-            hk_span = scnt
-            if hist_axis is not None:
-                # tier-selector sync: a scalar pmax per split — tiny on
-                # the wire but a full collective latency, so it belongs
-                # in the interconnect inventory
-                _tl.record_collective(
-                    "leafcompact/tier_pmax", "pmax", hist_axis,
-                    _tl._tree_nbytes(hk_span), loop=L - 1, phase="grow")
-                hk_span = jax.lax.pmax(hk_span, hist_axis)
-            small_hist = jax.lax.switch(
-                bucket_of(hk_span), hist_branches,
-                (pane2, sstart, scnt, new_leaf))
-
-            parent_hist = state.hist_cache[bl]
-            large_hist = parent_hist - small_hist
-            lhist = jnp.where(left_small, small_hist, large_hist)
-            rhist = jnp.where(left_small, large_hist, small_hist)
-            hist_cache = (state.hist_cache.at[bl].set(lhist)
-                          .at[new_leaf].set(rhist))
-
-            lg, lh = state.cand_left_g[bl], state.cand_left_h[bl]
-            rg, rh = state.cand_right_g[bl], state.cand_right_h[bl]
-            depth = state.leaf_depth[bl] + 1
-
-            lbest, rbest = best_of_pair(lhist, rhist, lg, lh,
-                                        lcnt.astype(f32), rg, rh,
-                                        rcnt.astype(f32), depth)
-
-            tree = tree._replace(
-                num_leaves=nl + 1,
-                split_feature=tree.split_feature.at[node].set(feat),
-                threshold_bin=tree.threshold_bin.at[node].set(thr),
-                split_gain=tree.split_gain.at[node].set(best_gain),
-                left_child=left_child,
-                right_child=right_child,
-                leaf_parent=tree.leaf_parent.at[bl].set(node)
-                                            .at[new_leaf].set(node),
-                leaf_value=tree.leaf_value
-                               .at[bl].set(state.cand_left_out[bl])
-                               .at[new_leaf].set(state.cand_right_out[bl]),
-                leaf_count=tree.leaf_count.at[bl].set(lcnt)
-                                          .at[new_leaf].set(rcnt),
-                leaf_ids=leaf_ids,
-            )
-            return state._replace(
-                tree=tree,
-                pane=pane2,
-                seg_start=state.seg_start.at[new_leaf].set(start + plcnt),
-                seg_cnt=state.seg_cnt.at[bl].set(plcnt)
-                                     .at[new_leaf].set(prcnt),
-                seg_bucket=state.seg_bucket.at[bl].set(bucket_of(plcnt))
-                                           .at[new_leaf].set(
-                                               bucket_of(prcnt)),
-                hist_cache=hist_cache,
-                cand_gain=state.cand_gain.at[bl].set(lbest.gain)
-                                         .at[new_leaf].set(rbest.gain),
-                cand_feature=state.cand_feature.at[bl].set(lbest.feature)
-                                               .at[new_leaf]
-                                               .set(rbest.feature),
-                cand_threshold=state.cand_threshold
-                                    .at[bl].set(lbest.threshold)
-                                    .at[new_leaf].set(rbest.threshold),
-                cand_left_out=state.cand_left_out
-                                   .at[bl].set(lbest.left_output)
-                                   .at[new_leaf].set(rbest.left_output),
-                cand_right_out=state.cand_right_out
-                                    .at[bl].set(lbest.right_output)
-                                    .at[new_leaf].set(rbest.right_output),
-                cand_left_cnt=state.cand_left_cnt
-                                   .at[bl].set(lbest.left_count)
-                                   .at[new_leaf].set(rbest.left_count),
-                cand_right_cnt=state.cand_right_cnt
-                                    .at[bl].set(lbest.right_count)
-                                    .at[new_leaf].set(rbest.right_count),
-                cand_left_g=state.cand_left_g
-                                 .at[bl].set(lbest.left_sum_grad)
-                                 .at[new_leaf].set(rbest.left_sum_grad),
-                cand_left_h=state.cand_left_h
-                                 .at[bl].set(lbest.left_sum_hess)
-                                 .at[new_leaf].set(rbest.left_sum_hess),
-                cand_right_g=state.cand_right_g
-                                  .at[bl].set(lbest.right_sum_grad)
-                                  .at[new_leaf].set(rbest.right_sum_grad),
-                cand_right_h=state.cand_right_h
-                                  .at[bl].set(lbest.right_sum_hess)
-                                  .at[new_leaf].set(rbest.right_sum_hess),
-                leaf_depth=state.leaf_depth.at[bl].set(depth)
-                                           .at[new_leaf].set(depth),
-            )
-
-        def no_split(state: _CompactState) -> _CompactState:
-            return state._replace(done=jnp.asarray(True))
-
-        # profiler alignment (ISSUE 2): label the compacted split body so
-        # profile_dir= traces group its partition/histogram ops per split
-        with jax.named_scope("leafcompact_split"):
-            return jax.lax.cond(should_split, do_split, no_split, state)
-
-    state = jax.lax.fori_loop(0, L - 1, body, state)
-    return state if return_state else state.tree
+    """Historical keyword-seam surface over
+    ``grow_tree_unified(policy="leafcompact")``."""
+    schedule = SeamSchedule(
+        hist_axis=hist_axis, hist_reduce=hist_reduce,
+        int_hist_reduce=int_hist_reduce, stat_reduce=stat_reduce,
+        root_hist_reduce=root_hist_reduce, own_slice=own_slice,
+        split_finder=split_finder)
+    return grow_tree_unified(
+        bins, grad, hess, row_mask, feature_mask, num_bins,
+        policy="leafcompact", num_leaves=num_leaves,
+        num_bins_max=num_bins_max, min_data_in_leaf=min_data_in_leaf,
+        min_sum_hessian_in_leaf=min_sum_hessian_in_leaf,
+        max_depth=max_depth, hist_backend=hist_backend,
+        hist_chunk=hist_chunk, compute_dtype=compute_dtype,
+        packing=packing,
+        use_pallas_partition=use_pallas_partition,
+        partition_overlap=partition_overlap, interpret=interpret,
+        schedule=schedule, return_state=return_state)

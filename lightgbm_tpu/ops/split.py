@@ -89,9 +89,13 @@ def _find_best_split_impl(hist, sum_grad, sum_hess, num_data, num_bins,
             min_data_in_leaf, min_sum_hessian_in_leaf)
 
 
-def _find_best_split_scoped(hist, sum_grad, sum_hess, num_data, num_bins,
-                            feature_mask, min_data_in_leaf,
-                            min_sum_hessian_in_leaf) -> SplitResult:
+def _threshold_scan(hist, sum_grad, sum_hess, num_data, num_bins,
+                    feature_mask, min_data_in_leaf,
+                    min_sum_hessian_in_leaf):
+    """Shared [F, B] threshold scan: cumulative left sums, the validity
+    mask and the per-candidate gain score — the common core of the full
+    best-split search and the voting learner's per-feature local gains.
+    Returns (cg, ch, cc, score, gain_shift)."""
     F, B, _ = hist.shape
     eps = jnp.float32(K_EPSILON)
 
@@ -126,6 +130,35 @@ def _find_best_split_scoped(hist, sum_grad, sum_hess, num_data, num_bins,
                     + _leaf_split_gain(right_g, right_h))
     valid = valid & (current_gain >= gain_shift)
     score = jnp.where(valid, current_gain, NEG_INF)
+    return cg, ch, cc, score, gain_shift
+
+
+def per_feature_best_scores(hist, sum_grad, sum_hess, num_data, num_bins,
+                            feature_mask, min_data_in_leaf,
+                            min_sum_hessian_in_leaf) -> jax.Array:
+    """[F] best (unshifted) split score per feature, -inf when a feature
+    has no valid candidate — the voting learner's LOCAL gain vector
+    (ISSUE 9; PV-tree / the reference's absent voting_parallel design):
+    each shard proposes its top-k features by this score, and only the
+    globally-voted features' histograms are exchanged."""
+    _, _, _, score, _ = _threshold_scan(
+        hist, sum_grad, sum_hess, num_data, num_bins, feature_mask,
+        min_data_in_leaf, min_sum_hessian_in_leaf)
+    return jnp.max(score, axis=1)
+
+
+def _find_best_split_scoped(hist, sum_grad, sum_hess, num_data, num_bins,
+                            feature_mask, min_data_in_leaf,
+                            min_sum_hessian_in_leaf) -> SplitResult:
+    F, B, _ = hist.shape
+    eps = jnp.float32(K_EPSILON)
+    total_g = sum_grad.astype(jnp.float32)
+    total_h = sum_hess.astype(jnp.float32)
+    total_c = num_data.astype(jnp.float32)
+
+    cg, ch, cc, score, gain_shift = _threshold_scan(
+        hist, sum_grad, sum_hess, num_data, num_bins, feature_mask,
+        min_data_in_leaf, min_sum_hessian_in_leaf)
 
     # within-feature argmax, larger threshold wins ties → argmax on the
     # reversed threshold axis

@@ -2,15 +2,27 @@
 
 Replaces the reference's Network layer (/root/reference/src/network/ —
 Bruck allgather, recursive-halving reduce-scatter over sockets/MPI) with XLA
-collectives inside ``shard_map``:
+collectives inside ``shard_map``, on a 1-D ``(data,)`` or explicit 2-D
+``(data, feature)`` mesh (ISSUE 9):
 
 - data-parallel  (data_parallel_tree_learner.cpp)  → rows sharded over the
   ``data`` mesh axis, histograms ``psum``/``psum_scatter``'d, split decisions
   replicated → bit-identical trees on every shard.
 - feature-parallel (feature_parallel_tree_learner.cpp) → per-shard feature
   ownership masks + packed argmax allreduce of SplitInfo.
+- hybrid → rows on ``data`` AND feature blocks on ``feature`` of one 2-D
+  mesh (``num_machines = data_shards × feature_shards``); the histogram
+  reduce is a data-axis psum restricted to the owned block, so per-shard
+  wire bytes drop to O(F·B / feature_shards).
+- voting → the reference's named-but-absent PV-tree mode realized: top-k
+  per-shard split voting over the data axis; full histograms exchanged
+  only for the ≤2·top_k globally-voted features.
 - distributed bin finding (dataset.cpp:353-415) → feature-sliced FindBin +
   allgather.
+
+All four learners drive the ONE schedule-parameterized grower
+(models/grower_unified.py) by handing it a declarative ``SeamSchedule``
+— the learners differ only in which collectives the seams wrap.
 
 Multi-host bootstrap (socket mlist / MPI ranks, linkers_socket.cpp) maps to
 ``jax.distributed.initialize`` + the global device mesh.

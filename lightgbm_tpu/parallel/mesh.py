@@ -118,6 +118,73 @@ def get_mesh(num_machines: Optional[int] = None,
     return Mesh(np.array(devices[:num_machines]), (axis_name,))
 
 
+def factor_machines(num_machines: int, feature_shards: int = 0,
+                    voting: bool = False) -> "tuple[int, int]":
+    """Factor ``num_machines`` into ``(data_shards, feature_shards)`` for
+    the 2-D hybrid mesh (ISSUE 9).
+
+    ``feature_shards > 0`` (the config knob) is honored exactly and must
+    divide num_machines (loud error otherwise — a silent re-factor would
+    change the wire bytes the perf gate tracks).  ``feature_shards == 0``
+    resolves automatically:
+
+    - hybrid: the largest divisor of num_machines that is <= sqrt(
+      num_machines) — rows get at least as many shards as features (the
+      histogram's row dimension is the one that grows with data), e.g.
+      4 -> (2, 2), 8 -> (4, 2), 6 -> (3, 2), primes -> (n, 1).
+    - voting: (num_machines, 1) — the reference's voting design is pure
+      data-parallel (top-k votes over row shards); feature sharding
+      composes only when asked for explicitly.
+
+    A factoring with feature_shards == 1 degenerates to pure data
+    parallelism on the ``data`` axis (documented fallback: hybrid then
+    records the same wire bytes as tree_learner=data/psum)."""
+    n = max(int(num_machines), 1)
+    if feature_shards > 0:
+        if n % feature_shards:
+            log.fatal("feature_shards=%d does not divide num_machines=%d"
+                      % (feature_shards, n))
+        return n // feature_shards, feature_shards
+    if voting:
+        return n, 1
+    fs = 1
+    for d in range(2, int(n ** 0.5) + 1):
+        if n % d == 0:
+            fs = d
+    return n // fs, fs
+
+
+def get_mesh2d(num_machines: Optional[int] = None,
+               feature_shards: int = 0, device_type: str = "",
+               voting: bool = False) -> Mesh:
+    """Explicit 2-D ``(data, feature)`` mesh over the first
+    ``num_machines`` devices (ISSUE 9): rows shard over the ``data``
+    axis, feature-block ownership lives on the ``feature`` axis, so the
+    histogram reduce (psum over ``data`` restricted to owned blocks) and
+    the SplitInfo allreduce (over ``feature``) ride different axes of
+    one mesh — the hybrid data x feature plan the reference names but
+    never implements (SURVEY.md "Voting-parallel: named but absent").
+
+    Multi-process hybrid runs are not supported in this revision: the
+    row-shard lift (make_global_rows) assumes the 1-D process-ordered
+    mesh — fail loudly instead of training on a wrong layout."""
+    if jax.process_count() > 1:
+        log.fatal("tree_learner=hybrid/voting is single-process in this "
+                  "revision (multi-process keeps the 1-D data mesh)")
+    devices = jax.devices(device_type) if device_type else jax.devices()
+    if num_machines is None or num_machines <= 0:
+        num_machines = len(devices)
+    if num_machines > len(devices):
+        log.warning(
+            "num_machines=%d exceeds available devices (%d); shrinking "
+            "world size to match (linkers_socket.cpp:106-109 behavior)"
+            % (num_machines, len(devices)))
+        num_machines = len(devices)
+    ds, fs = factor_machines(num_machines, feature_shards, voting=voting)
+    grid = np.array(devices[:ds * fs]).reshape(ds, fs)
+    return Mesh(grid, (DATA_AXIS, FEATURE_AXIS))
+
+
 def dataset_row_sharding(num_rows: int, shard_rows: bool = False,
                          num_machines: Optional[int] = None,
                          device_type: str = "",

@@ -24,6 +24,15 @@ round against the earlier trajectory:
   The block is read from the record itself or parsed out of the smoke
   run's ``tail`` (dryrun_multichip prints one ``MULTICHIP_OBS`` JSON
   line).
+- **wire bytes** (ISSUE 9): the ``MULTICHIP_WIRE`` line's logical
+  ``wire_bytes_per_iter`` per tree learner (data / hybrid / voting at
+  the F=28, B=255 schema).  These are DETERMINISTIC — traced shapes x
+  loop estimates, no timing noise — so the must-not-grow band is the
+  tight rate-key floor, compared only across rounds at the same device
+  count; and two ABSOLUTE findings need no trajectory at all: hybrid
+  recording >= pure-DP bytes (the 2-D owned-block restriction stopped
+  paying) and voting recording >= hybrid bytes (the voted exchange
+  stopped paying).
 
 Entries are grouped by their ``metric`` name (an 11M round is never
 compared to a 1M round) and, when the ``host`` block is present
@@ -124,28 +133,39 @@ def load_entry(path: str) -> dict:
 
 def _attach_multichip_obs(rec: dict) -> None:
     """Surface the distributed-observability block on a multichip record:
-    either already present as ``skew``/``interconnect`` keys, or parsed
-    from the smoke run's captured ``tail`` (dryrun_multichip prints one
-    ``MULTICHIP_OBS <json>`` line).  Malformed/absent lines leave the
-    record untouched — pre-ISSUE-5 rounds simply have no obs series."""
-    if "skew" in rec:
-        return
+    either already present as ``skew``/``interconnect``/``wire`` keys, or
+    parsed from the smoke run's captured ``tail`` (dryrun_multichip
+    prints one ``MULTICHIP_OBS <json>`` line and, since ISSUE 9, one
+    ``MULTICHIP_WIRE <json>`` line).  Malformed/absent lines leave the
+    record untouched — earlier rounds simply have no such series."""
     tail = rec.get("tail")
-    if not isinstance(tail, str):
-        return
-    for line in reversed(tail.splitlines()):
-        line = line.strip()
-        if not line.startswith("MULTICHIP_OBS "):
-            continue
-        try:
-            obs = json.loads(line[len("MULTICHIP_OBS "):])
-        except ValueError:
-            return
-        if isinstance(obs, dict):
-            for key in ("skew", "interconnect", "simulated_hosts"):
-                if key in obs:
-                    rec[key] = obs[key]
-        return
+    lines = tail.splitlines() if isinstance(tail, str) else []
+    if "skew" not in rec:
+        for line in reversed(lines):
+            line = line.strip()
+            if not line.startswith("MULTICHIP_OBS "):
+                continue
+            try:
+                obs = json.loads(line[len("MULTICHIP_OBS "):])
+            except ValueError:
+                break
+            if isinstance(obs, dict):
+                for key in ("skew", "interconnect", "simulated_hosts"):
+                    if key in obs:
+                        rec[key] = obs[key]
+            break
+    if "wire" not in rec:
+        for line in reversed(lines):
+            line = line.strip()
+            if not line.startswith("MULTICHIP_WIRE "):
+                continue
+            try:
+                wire = json.loads(line[len("MULTICHIP_WIRE "):])
+            except ValueError:
+                break
+            if isinstance(wire, dict):
+                rec["wire"] = wire
+            break
 
 
 def _fractions(rec: dict) -> Dict[str, float]:
@@ -257,6 +277,13 @@ def _multichip_obs_value(rec: dict, key: str) -> Optional[float]:
                 ic.get("attained_gb_per_s"), (int, float)) \
                 and ic["attained_gb_per_s"] > 0:
             return float(ic["attained_gb_per_s"])
+    if key.startswith("wire/"):
+        wire = rec.get("wire")
+        if isinstance(wire, dict):
+            v = (wire.get("wire_bytes_per_iter") or {}).get(
+                key.split("/", 1)[1])
+            if isinstance(v, (int, float)) and v > 0:
+                return float(v)
     return None
 
 
@@ -312,6 +339,58 @@ def _check_multichip(entries: List[dict], findings: List[dict],
             })
 
 
+def _check_wire(entries: List[dict], findings: List[dict],
+                floor: float = DEFAULT_FLOOR,
+                sigma_mult: float = DEFAULT_SIGMA_MULT) -> None:
+    """ISSUE 9: the logical wire-bytes-per-iteration series from the
+    MULTICHIP_WIRE block.  Two absolute findings on the latest round
+    (hybrid >= pure-DP bytes; voting >= hybrid bytes — the 2-D/voted
+    restrictions stopped paying), plus a must-not-grow gate per learner
+    with the TIGHT rate-key band (the series is deterministic: traced
+    shapes x loop estimates, zero timing noise), compared only across
+    rounds at the same device count."""
+    latest = entries[-1]
+    wire = latest["rec"].get("wire")
+    if isinstance(wire, dict):
+        w = wire.get("wire_bytes_per_iter") or {}
+        for a, b in (("hybrid", "data"), ("voting", "hybrid")):
+            va, vb = w.get(a), w.get(b)
+            if isinstance(va, (int, float)) and isinstance(
+                    vb, (int, float)) and va >= vb > 0:
+                findings.append({
+                    "metric": "multichip", "key": "wire/%s_vs_%s" % (a, b),
+                    "latest_round": latest["round"],
+                    "latest": va, "baseline": vb,
+                    "detail": "%s records >= %s logical wire bytes per "
+                              "iteration on the same device count" % (a, b),
+                })
+    if len(entries) < 2:
+        return
+    sigma = floor / 2.0
+    nd = (wire or {}).get("n_devices")
+    for learner in ("data", "hybrid", "voting"):
+        key = "wire/" + learner
+        series = [(e["round"], _multichip_obs_value(e["rec"], key))
+                  for e in entries
+                  if (e["rec"].get("wire") or {}).get("n_devices") == nd]
+        series = [(r, v) for r, v in series if v is not None]
+        if len(series) < 2 or series[-1][0] != latest["round"]:
+            continue
+        prior = [v for _, v in series[:-1]]
+        latest_v = series[-1][1]
+        baseline = _median(prior)
+        if baseline <= 0:
+            continue
+        if latest_v > baseline * (1.0 + sigma_mult * sigma):
+            findings.append({
+                "metric": "multichip", "key": key,
+                "latest_round": latest["round"],
+                "latest": latest_v, "baseline": round(baseline, 6),
+                "drop": round(latest_v / baseline - 1.0, 4),
+                "allowed_drop": round(sigma_mult * sigma, 4),
+            })
+
+
 def check_files(paths: List[str], floor: float = DEFAULT_FLOOR,
                 sigma_mult: float = DEFAULT_SIGMA_MULT,
                 allow_cross_hardware: bool = False) -> dict:
@@ -334,6 +413,9 @@ def check_files(paths: List[str], floor: float = DEFAULT_FLOOR,
                      allow_cross_hardware, findings)
     _check_multichip(multichip, findings, floor=floor,
                      sigma_mult=sigma_mult)
+    if multichip:
+        _check_wire(sorted(multichip, key=lambda e: e["round"]), findings,
+                    floor=floor, sigma_mult=sigma_mult)
     return {
         "files": len(entries),
         "groups": {m: len(g) for m, g in sorted(groups.items())},

@@ -49,14 +49,31 @@ def _telemetry_leak_guard():
     leaked_timeline = telemetry.timeline_enabled()
     telemetry.disable()
     telemetry.reset()
+    # ISSUE 9 surface: a test that enters ``with mesh:`` and leaks it
+    # (an exception before __exit__, a kept generator) leaves a global
+    # mesh context installed — later tests' jit'd reductions silently
+    # become GSPMD-partitioned over it, breaking the serial growers'
+    # bit-identity pins in ways that only reproduce under THIS test
+    # order.  The learners never install a global mesh (shard_map takes
+    # the mesh explicitly), so any non-default mesh here is a leak.
+    leaked_mesh = None
+    try:
+        from jax._src import mesh as _mesh_lib
+        env_mesh = _mesh_lib.thread_resources.env.physical_mesh
+        if not env_mesh.empty:
+            leaked_mesh = env_mesh
+            _mesh_lib.thread_resources.env = _mesh_lib.EMPTY_ENV
+    except (ImportError, AttributeError):  # pragma: no cover - jax drift
+        pass
     assert not (leaked_enabled or leaked_sink or leaked_watchdog
-                or leaked_timeline), (
-        "test left telemetry %s — disable() it (or use a fixture) so "
-        "state cannot leak between tests"
-        % ("with a live watchdog thread" if leaked_watchdog
-           else "in timeline/shard mode" if leaked_timeline
-           else "enabled with an open sink" if leaked_sink
-           else "enabled"))
+                or leaked_timeline or leaked_mesh is not None), (
+        "test left %s — clean up (telemetry.disable() / exit the mesh "
+        "context, or use a fixture) so state cannot leak between tests"
+        % ("telemetry with a live watchdog thread" if leaked_watchdog
+           else "telemetry in timeline/shard mode" if leaked_timeline
+           else "telemetry enabled with an open sink" if leaked_sink
+           else "telemetry enabled" if leaked_enabled
+           else "a global mesh context installed (%r)" % (leaked_mesh,)))
 
 
 @pytest.fixture(scope="session")

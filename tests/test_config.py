@@ -59,10 +59,23 @@ def test_parallel_conflict_resolution():
     assert cfg.is_parallel_find_bin
 
 
-def test_voting_rejected():
-    # this snapshot rejects tree_learner=voting (config.cpp:311-313)
+def test_hybrid_voting_learners_accepted():
+    # the reference snapshot Fatals on tree_learner=voting
+    # (config.cpp:311-313); ISSUE 9 realizes it, plus the 2-D hybrid
+    # learner, with the mesh-factoring / vote-width knobs
+    cfg = _set({"tree_learner": "voting", "num_machines": "2"})
+    assert cfg.boosting_config.tree_learner == "voting"
+    assert cfg.is_parallel
+    assert cfg.boosting_config.tree_config.top_k == 20  # PV-tree default
+    cfg = _set({"tree_learner": "hybrid", "num_machines": "4",
+                "feature_shards": "2", "topk": "7"})
+    assert cfg.boosting_config.tree_learner == "hybrid"
+    assert cfg.boosting_config.tree_config.feature_shards == 2
+    assert cfg.boosting_config.tree_config.top_k == 7  # topk alias
     with pytest.raises(LightGBMError):
-        _set({"tree_learner": "voting", "num_machines": "2"})
+        _set({"feature_shards": "-1"})
+    with pytest.raises(LightGBMError):
+        _set({"top_k": "0"})
 
 
 def test_bad_values():

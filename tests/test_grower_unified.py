@@ -1,0 +1,145 @@
+"""Differential pin across the three growth policies (ISSUE 9).
+
+Recorded BEFORE the three grower modules were collapsed into
+``models/grower_unified.py``: the same dataset/config trained under every
+growth policy, asserting the known-equal surfaces —
+
+- masked leaf-wise == compacted leaf-wise: identical split STRUCTURE
+  (features, thresholds, leaf counts), leaf values within the repo's
+  documented cross-program budget (recorded here: XLA CPU contracts the
+  two growers' value math into different fusions — max observed delta
+  ~3e-7 relative on this container, i.e. ulp dust, NOT bitwise — so the
+  collapse must not be held to a bar the pre-collapse growers never met);
+- every policy's model text matches the digest recorded from the
+  pre-collapse growers on this container's CPU backend, so any silent
+  behavioral drift introduced by the collapse (a seam applied twice, a
+  reordered reduction, a changed tie-break) is caught here, not in a
+  downstream bench round.
+
+Digests are CPU-golden (the tier-1 environment pins JAX_PLATFORMS=cpu);
+other backends skip the digest rows and keep the cross-policy equalities.
+Set LGBM_TPU_PRINT_DIGESTS=1 to print current digests for re-recording.
+"""
+import hashlib
+import os
+
+import numpy as np
+import jax
+import pytest
+
+from lightgbm_tpu.config import OverallConfig
+from lightgbm_tpu.io.dataset import Dataset
+from lightgbm_tpu.models.gbdt import GBDT
+from lightgbm_tpu.objectives import create_objective
+
+
+def _data():
+    rng = np.random.RandomState(97)
+    n, f = 1200, 8
+    x = rng.randn(n, f)
+    y = ((x[:, 0] - 0.6 * x[:, 1] + 0.25 * x[:, 2]
+          + 0.3 * rng.randn(n)) > 0).astype(np.float32)
+    return x, y
+
+
+def _train(x, y, *, grow_policy, leafwise_compact="false",
+           hist_dtype="float32", iters=4):
+    cfg = OverallConfig()
+    cfg.set({"objective": "binary", "num_leaves": "15",
+             "min_data_in_leaf": "20", "min_sum_hessian_in_leaf": "1.0",
+             "learning_rate": "0.2", "grow_policy": grow_policy,
+             "leafwise_compact": leafwise_compact,
+             "hist_dtype": hist_dtype}, require_data=False)
+    ds = Dataset.from_arrays(x, y, max_bin=32)
+    b = GBDT()
+    b.init(cfg.boosting_config, ds,
+           create_objective(cfg.objective_type, cfg.objective_config))
+    for _ in range(iters):
+        if b.train_one_iter(is_eval=False):
+            break
+    return b
+
+
+def _model_text(booster) -> str:
+    return "\n".join("Tree=%d\n%s" % (i, t.to_string())
+                     for i, t in enumerate(booster.models))
+
+
+def _digest(booster) -> str:
+    return hashlib.sha256(_model_text(booster).encode()).hexdigest()[:16]
+
+
+# model-text digests recorded from the PRE-collapse growers (grower.py /
+# grower_depthwise.py / grower_leafcompact.py as of PR 8) on this
+# container's XLA CPU backend — the collapse must reproduce them exactly
+RECORDED_CPU_DIGESTS = {
+    "leafwise": "e339cc60be3d84e6",
+    "leafwise_compact": "aabd036b9d78bc5d",
+    "depthwise": "1d10ebf030a5c580",
+}
+
+
+@pytest.fixture(scope="module")
+def boosters():
+    x, y = _data()
+    return {
+        "leafwise": _train(x, y, grow_policy="leafwise"),
+        "leafwise_compact": _train(x, y, grow_policy="leafwise",
+                                   leafwise_compact="true"),
+        "depthwise": _train(x, y, grow_policy="depthwise"),
+    }
+
+
+def test_all_policies_trained(boosters):
+    for name, b in boosters.items():
+        assert len(b.models) == 4, name
+        for t in b.models:
+            assert t.num_leaves > 1, name
+
+
+def test_masked_equals_compact(boosters):
+    """The compacted leaf-wise grower is the masked grower's split
+    sequence with compacted data movement: identical split structure and
+    leaf counts; leaf values/scores within the documented cross-program
+    f32 budget (recorded pre-collapse: ulp-level fusion dust, see module
+    docstring — NOT bitwise on XLA CPU)."""
+    a, b = boosters["leafwise"], boosters["leafwise_compact"]
+    for k, (t1, t2) in enumerate(zip(a.models, b.models)):
+        assert t1.num_leaves == t2.num_leaves, f"tree {k}"
+        np.testing.assert_array_equal(t1.split_feature, t2.split_feature)
+        np.testing.assert_array_equal(t1.threshold_bin, t2.threshold_bin)
+        np.testing.assert_allclose(t1.leaf_value, t2.leaf_value,
+                                   rtol=1e-5, atol=5e-7, err_msg=f"tree {k}")
+    np.testing.assert_allclose(np.asarray(a.score), np.asarray(b.score),
+                               rtol=1e-5, atol=2e-6)
+
+
+def test_masked_equals_compact_int8():
+    """Same pin under int8 histograms: structure exact; leaf values
+    within the documented cross-program 1-ulp budget (XLA CPU contracts
+    the dequantize multiply into an FMA in some program contexts —
+    grower_leafcompact module docstring)."""
+    x, y = _data()
+    a = _train(x, y, grow_policy="leafwise", hist_dtype="int8")
+    b = _train(x, y, grow_policy="leafwise", leafwise_compact="true",
+               hist_dtype="int8")
+    for k, (t1, t2) in enumerate(zip(a.models, b.models)):
+        assert t1.num_leaves == t2.num_leaves, f"tree {k}"
+        np.testing.assert_array_equal(t1.split_feature, t2.split_feature)
+        np.testing.assert_array_equal(t1.threshold_bin, t2.threshold_bin)
+        np.testing.assert_allclose(t1.leaf_value, t2.leaf_value,
+                                   rtol=1e-6, atol=1e-9, err_msg=f"tree {k}")
+
+
+@pytest.mark.parametrize("policy", sorted(RECORDED_CPU_DIGESTS))
+def test_model_text_digest_pinned(boosters, policy):
+    """Every policy's model text matches the digest recorded from the
+    pre-collapse growers — the drift detector for the collapse."""
+    if jax.default_backend() != "cpu":
+        pytest.skip("digests recorded on the XLA CPU backend")
+    got = _digest(boosters[policy])
+    if os.environ.get("LGBM_TPU_PRINT_DIGESTS") == "1":
+        print("DIGEST %s %s" % (policy, got))
+    assert got == RECORDED_CPU_DIGESTS[policy], (
+        "%s model text drifted from the pre-collapse grower (got %s)"
+        % (policy, got))

@@ -205,6 +205,71 @@ def test_multichip_rounds_without_obs_are_not_compared(tmp_path):
     assert perf_gate.check_files(paths)["findings"] == []
 
 
+# --------------------------------------------------- wire-bytes gate (ISSUE 9)
+
+def _write_wire(tmp_path, n, data=None, hybrid=None, voting=None,
+                n_devices=4, via_tail=False):
+    rec = {"n_devices": 8, "rc": 0, "ok": True}
+    w = {k: v for k, v in
+         (("data", data), ("hybrid", hybrid), ("voting", voting))
+         if v is not None}
+    wire = {"n_devices": n_devices, "schema": {"F": 28, "B": 255},
+            "wire_bytes_per_iter": w, "sites": {}}
+    if via_tail:
+        rec["tail"] = ("[LightGBM] [Info] whatever\nMULTICHIP_WIRE "
+                       + json.dumps(wire) + "\n")
+    else:
+        rec["wire"] = wire
+    path = tmp_path / f"MULTICHIP_r{n:02d}.json"
+    path.write_text(json.dumps(rec))
+    return str(path)
+
+
+def test_wire_hybrid_not_below_dp_flagged_absolutely(tmp_path):
+    """hybrid >= pure-DP bytes on the same device count is an absolute
+    finding — no trajectory needed (and voting >= hybrid likewise)."""
+    p = _write_wire(tmp_path, 1, data=1000, hybrid=1000, voting=1200)
+    report = perf_gate.check_files([p])
+    keys = [f["key"] for f in report["findings"]]
+    assert "wire/hybrid_vs_data" in keys
+    assert "wire/voting_vs_hybrid" in keys
+
+
+def test_wire_growth_flagged(tmp_path):
+    """The logical series is deterministic, so the must-not-grow band is
+    the tight rate-key floor: a 10% growth flags."""
+    paths = [_write_wire(tmp_path, n, data=10000, hybrid=h, voting=3000)
+             for n, h in enumerate([5000, 5000, 5500], start=1)]
+    report = perf_gate.check_files(paths)
+    keys = [f["key"] for f in report["findings"]]
+    assert "wire/hybrid" in keys
+
+
+def test_wire_stable_ordering_passes(tmp_path):
+    paths = [_write_wire(tmp_path, n, data=10000, hybrid=5000, voting=3000,
+                         via_tail=(n == 3))
+             for n in (1, 2, 3)]
+    assert perf_gate.check_files(paths)["findings"] == []
+
+
+def test_wire_cross_device_counts_not_compared(tmp_path):
+    """A round measured at a different device count starts its own wire
+    series (more shards legitimately move different bytes)."""
+    paths = [_write_wire(tmp_path, 1, data=10000, hybrid=5000,
+                         n_devices=4),
+             _write_wire(tmp_path, 2, data=20000, hybrid=9000,
+                         n_devices=8)]
+    assert perf_gate.check_files(paths)["findings"] == []
+
+
+def test_wire_rounds_without_block_are_not_compared(tmp_path):
+    """Pre-ISSUE-9 rounds (no wire block) must not break the gate."""
+    ok = tmp_path / "MULTICHIP_r01.json"
+    ok.write_text(json.dumps({"n_devices": 8, "rc": 0, "ok": True}))
+    p2 = _write_wire(tmp_path, 2, data=10000, hybrid=5000, voting=3000)
+    assert perf_gate.check_files([str(ok), p2])["findings"] == []
+
+
 def test_malformed_file_is_a_one_line_error(tmp_path):
     p = tmp_path / "BENCH_r01.json"
     p.write_text("{not json")
