@@ -1,0 +1,26 @@
+"""graftlint: AST + jaxpr invariant analysis over the package (ISSUE 10).
+
+PRs 3-9 each needed manual "review-hardened" passes to catch the same
+recurring defect classes — unwrapped collective seams, jit cache keys
+missing a resolved-config bit, unfenced device-work spans, width-unsafe
+dtype narrowing, f32 contamination of the int8 bit-identity chain.  This
+package encodes those invariants ONCE as machine-checked rules:
+
+- **Layer 1 (AST, no JAX import)** — ``ast_rules``: R1
+  collective-seam-coverage, R2 cache-key-completeness, R3 span-fencing,
+  R4 banned-patterns-in-traced-code.
+- **Layer 2 (jaxpr)** — ``jaxpr_rules`` over the canonical small-schema
+  programs (``programs``): J1 dtype discipline on the int8 accumulator
+  path, J2 collective census vs the declared telemetry seam inventory.
+
+Drive it with ``python scripts/graftlint.py --check`` (exit 0 clean / 1
+findings / 2 tool error, mirroring perf_gate) or through the tier-1
+wrapper in tests/test_graftlint.py.  Accepted sites live in
+``GRAFTLINT_BASELINE.json`` with written justifications — suppression is
+always explicit, never silent.
+"""
+from .findings import RULES, Baseline, Finding               # noqa: F401
+from .ast_rules import (LintConfig, lint_package,            # noqa: F401
+                        run_ast_rules)
+from .driver import (GraftlintError, default_baseline_path,  # noqa: F401
+                     package_root, run, run_ast_layer, run_jaxpr_layer)

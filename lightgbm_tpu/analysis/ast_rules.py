@@ -1,0 +1,473 @@
+"""Layer 1: AST invariant rules over the package source (no JAX import).
+
+The rules encode, as machine checks, the defect classes PRs 3-9 kept
+re-fixing in "review-hardened" passes:
+
+- **R1 collective-seam-coverage** — every raw ``lax.psum / psum_scatter /
+  all_gather / pmax / pmin`` call site must be covered by the wire-metrics
+  layer (ISSUE 5): lexically inside a function that is passed through
+  ``telemetry.collective_span`` (directly or via a ``functools.partial``
+  alias like the learners' ``_c``), OR inside a function that files its
+  own ``telemetry.record_collective`` record, OR explicitly allowlisted
+  in the baseline with a justification.  This turns the PR 5/9 prose
+  claim "zero unwrapped seams" into a proof the driver re-runs forever.
+- **R2 cache-key-completeness** — a function that caches a compiled
+  program (a ``*_PROGRAMS[key] = ...`` store, or the ``self._jitted`` +
+  ``_jit_key`` pattern) and lexically reads a resolved-config bit
+  (``partition_overlap_on()`` / ``pallas_partition_ok()`` /
+  ``jax.default_backend()`` / ``leafwise_compact_on()`` / a
+  ``device_type`` read) must thread that bit into the key expression
+  (directly or through a local the key references) — the PR 3/7 stale-
+  kernel-routing class.
+- **R3 span-fencing** — a ``telemetry.span(name)`` whose name is in the
+  device-work set must bind the span and pass its device result through
+  ``.fence(...)``; an unfenced async span times the dispatch, not the
+  execution (the PR 7 predict-span bug).
+- **R4 banned-patterns-in-traced-code** — functions in the traced
+  grower/ops modules must not touch ``np.*`` / ``numpy.*``, host RNG
+  (``random.*`` / ``np.random``), ``time.*``, or float64 (``jnp.float64``
+  literals / ``dtype="float64"``): host-only constructs inside code
+  reachable from a jit either fail at trace time on TPU or silently
+  constant-fold trace-time values into the compiled program.
+
+Pure ``ast`` — importable (and runnable) without JAX, so the AST layer
+can gate environments where the accelerator stack is absent.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .findings import Finding
+
+COLLECTIVE_NAMES = ("psum", "psum_scatter", "all_gather", "pmax", "pmin",
+                    "all_to_all", "ppermute")
+
+# Resolved-config calls whose outcome bakes kernel routing into a traced
+# program: any cache-keyed program builder that consults one must carry
+# it in the key (R2).
+RESOLVED_CONFIG_CALLS = ("partition_overlap_on", "pallas_partition_ok",
+                         "default_backend", "leafwise_compact_on")
+# Resolved-config READS by attribute/constant name (same rule): the
+# device-steering knob __graft_entry__ flips between virtual meshes.
+RESOLVED_CONFIG_READS = ("device_type",)
+
+# Span names that time asynchronous device work and therefore must fence
+# their results (R3).  Host-side spans (eval, model_readback — a blocking
+# device_get — predict_encode, the ingest spans whose bodies block
+# explicitly) are deliberately NOT in the set.
+FENCED_SPANS = frozenset({
+    "histogram", "split_find", "partition", "grow", "score_update",
+    "valid_update", "train_chunk", "predict", "gradient", "goss",
+})
+
+# Module path suffixes whose function bodies are traced (reachable from a
+# jit) — the R4 scope.  parallel/learners.py stays out: its module-level
+# helpers (balanced_ownership) are host-side by design and its traced
+# shard closures live textually beside them.
+TRACED_MODULE_SUFFIXES = (
+    "models/grower_unified.py",
+    "ops/histogram.py", "ops/hist_pallas.py", "ops/split.py",
+    "ops/compact.py", "ops/scoring.py", "ops/lookup.py", "ops/sampling.py",
+)
+
+R4_BANNED_ROOTS = ("np", "numpy", "time", "random")
+
+
+class LintConfig:
+    """Per-run knobs, overridable by tests (golden fixtures mark their
+    tmp modules as traced) and by future callers extending the scope."""
+
+    def __init__(self, traced_suffixes=TRACED_MODULE_SUFFIXES,
+                 fenced_spans=FENCED_SPANS,
+                 host_allow: Optional[Set[str]] = None):
+        self.traced_suffixes = tuple(traced_suffixes)
+        self.fenced_spans = frozenset(fenced_spans)
+        # function names in traced modules that are host-side by design
+        self.host_allow = set(host_allow or ())
+
+
+def _attr_chain(node: ast.AST) -> List[str]:
+    """['jax', 'lax', 'psum'] for ``jax.lax.psum``; [] when not a plain
+    name/attribute chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        parts.reverse()
+        return parts
+    return []
+
+
+def _terminal_name(func: ast.AST) -> str:
+    chain = _attr_chain(func)
+    return chain[-1] if chain else ""
+
+
+def _annotate_parents(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def _enclosing(node: ast.AST, parents) -> List[ast.AST]:
+    """Ancestor chain innermost-first (the node itself excluded)."""
+    out = []
+    cur = parents.get(node)
+    while cur is not None:
+        out.append(cur)
+        cur = parents.get(cur)
+    return out
+
+
+def _func_qualname(node: ast.AST, parents) -> str:
+    names = []
+    for anc in [node] + _enclosing(node, parents):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            names.append(anc.name)
+        elif isinstance(anc, ast.ClassDef):
+            names.append(anc.name)
+    names.reverse()
+    return ".".join(names) or "<module>"
+
+
+class ModuleLint:
+    """One parsed module + the shared precomputations the rules need."""
+
+    def __init__(self, path: str, source: str, config: LintConfig):
+        self.path = path
+        self.config = config
+        self.tree = ast.parse(source, filename=path)
+        self.parents = _annotate_parents(self.tree)
+        self.findings: List[Finding] = []
+        self._collect_span_wrappers()
+
+    # -------------------------------------------------- wrapper discovery
+
+    def _collect_span_wrappers(self) -> None:
+        """Names that wrap seams: ``collective_span`` itself plus every
+        alias assigned from ``functools.partial(telemetry.collective_span,
+        ...)`` (the learners' ``_c``), module-wide.  Then the set of
+        function names / lambda nodes passed as arguments to any of
+        them."""
+        wrapper_names = {"collective_span"}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                           ast.Call):
+                call = node.value
+                if (_terminal_name(call.func) == "partial" and call.args
+                        and _terminal_name(call.args[0])
+                        == "collective_span"):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            wrapper_names.add(tgt.id)
+        self.wrapper_names = wrapper_names
+        # (scope node, name) pairs: a wrap only covers a function DEFINED
+        # in the same enclosing scope as the wrapper call — a bare
+        # module-wide name set would let an unwrapped function silently
+        # ride a same-named wrapped one elsewhere in the module
+        self.wrapped_fn_refs: Set[tuple] = set()
+        self.wrapper_calls: List[ast.Call] = []
+        for node in ast.walk(self.tree):
+            if (isinstance(node, ast.Call)
+                    and _terminal_name(node.func) in wrapper_names):
+                self.wrapper_calls.append(node)
+                scope = self._scope_of(node)
+                for arg in node.args:
+                    if isinstance(arg, ast.Name):
+                        self.wrapped_fn_refs.add((id(scope), arg.id))
+
+    def _scope_of(self, node: ast.AST) -> ast.AST:
+        """Innermost FunctionDef (or the Module) STRICTLY containing
+        ``node``."""
+        for anc in _enclosing(node, self.parents):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Module)):
+                return anc
+        return self.tree
+
+    def _in_wrapper_call(self, node: ast.AST) -> bool:
+        """True when ``node`` (a lambda / nested expr) sits inside the
+        argument list of a collective_span(-alias) call."""
+        for anc in _enclosing(node, self.parents):
+            if isinstance(anc, ast.Call) and anc in self.wrapper_calls:
+                return True
+        return False
+
+    def _function_records_collective(self, fn: ast.AST) -> bool:
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Call)
+                    and _terminal_name(node.func) == "record_collective"):
+                return True
+        return False
+
+    # ------------------------------------------------------------ rule R1
+
+    def rule_r1(self) -> None:
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _attr_chain(node.func)
+            if (len(chain) < 2 or chain[-1] not in COLLECTIVE_NAMES
+                    or chain[-2] != "lax"):
+                continue
+            covered = False
+            for anc in _enclosing(node, self.parents):
+                if isinstance(anc, ast.Lambda) and self._in_wrapper_call(anc):
+                    covered = True
+                    break
+                if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    wrapped_here = (id(self._scope_of(anc)),
+                                    anc.name) in self.wrapped_fn_refs
+                    if wrapped_here or self._function_records_collective(anc):
+                        covered = True
+                        break
+            if not covered:
+                self.findings.append(Finding(
+                    "R1", self.path, node.lineno,
+                    _func_qualname(node, self.parents),
+                    "lax." + chain[-1],
+                    "raw collective outside any telemetry.collective_span/"
+                    "record_collective coverage — the wire-metrics "
+                    "inventory (and the J2 census) cannot see it"))
+
+    # ------------------------------------------------------------ rule R2
+
+    @staticmethod
+    def _is_programs_store(node: ast.Assign):
+        """``X[key] = ...`` where X matches ``*_PROGRAMS`` → the key
+        expression node (the subscript index)."""
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Subscript):
+                base = _attr_chain(tgt.value)
+                if base and base[-1].endswith("_PROGRAMS"):
+                    return tgt.slice
+        return None
+
+    def rule_r2(self) -> None:
+        for fn in ast.walk(self.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            key_exprs: List[ast.AST] = []
+            caches = False
+            jitted_attr = False
+            key_attr = False
+            for node in ast.walk(fn):
+                # trigger attribution is INNERMOST-only: a caching store
+                # inside a nested closure must not also mark every
+                # enclosing function as a caching function (duplicate
+                # findings, double baseline entries)
+                if (not isinstance(node, ast.Assign)
+                        or self._innermost_fn(node) is not fn):
+                    continue
+                key_node = self._is_programs_store(node)
+                if key_node is not None:
+                    caches = True
+                    key_exprs.append(key_node)
+                for tgt in node.targets:
+                    if (isinstance(tgt, ast.Attribute)
+                            and tgt.attr == "_jitted"):
+                        jitted_attr = True
+                    if (isinstance(tgt, ast.Attribute)
+                            and tgt.attr.endswith("_key")):
+                        key_attr = True
+                        key_exprs.append(node.value)
+            if jitted_attr and key_attr:
+                caches = True
+            if not caches:
+                continue
+            # dataflow: the key expression, plus ONE level of local-name
+            # pull (``use_pp = ... pallas_partition_ok(...)`` feeding the
+            # key tuple).  Deliberately NOT transitive: a resolved-config
+            # read laundered through a derived value (num_shards <- mesh
+            # <- device_type) loses the bit's identity — two configs can
+            # derive the same num_shards from different device_types —
+            # so a deep chain must not count as key coverage.
+            assigns: Dict[str, List[ast.AST]] = {}
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            assigns.setdefault(tgt.id, []).append(node.value)
+            # resolve bare-Name seeds first (``self._jit_key = jit_key``
+            # names the tuple one hop away; that hop is seeding, not
+            # dataflow depth)
+            seeds: List[ast.AST] = []
+            for expr in key_exprs:
+                if isinstance(expr, ast.Name):
+                    seeds.extend(assigns.get(expr.id, []) or [expr])
+                else:
+                    seeds.append(expr)
+            # only BARE name references pull their assignment: a key
+            # component ``use_pp`` IS the resolved value, but ``mesh.size``
+            # is a derived projection of ``mesh`` that may have lost the
+            # resolved bit's identity — deriving must not count as
+            # coverage
+            closure = list(seeds)
+            names: Set[str] = set()
+            for expr in seeds:
+                for sub in ast.walk(expr):
+                    if isinstance(sub, ast.Name):
+                        par = self.parents.get(sub)
+                        derived = (isinstance(par, (ast.Attribute,
+                                                    ast.Subscript))
+                                   and par.value is sub)
+                        if not derived:
+                            names.add(sub.id)
+            for n in names:
+                closure.extend(assigns.get(n, []))
+
+            def in_key(pred) -> bool:
+                return any(pred(sub) for expr in closure
+                           for sub in ast.walk(expr))
+
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    name = _terminal_name(node.func)
+                    if name in RESOLVED_CONFIG_CALLS and not in_key(
+                            lambda s, _n=name: isinstance(s, ast.Call)
+                            and _terminal_name(s.func) == _n):
+                        self.findings.append(Finding(
+                            "R2", self.path, node.lineno, fn.name,
+                            name + "()",
+                            "resolved-config call read while building a "
+                            "cached program but absent from its cache "
+                            "key — a mid-process flip would reuse stale "
+                            "routing"))
+            for read in RESOLVED_CONFIG_READS:
+                reads = [n for n in ast.walk(fn)
+                         if (isinstance(n, ast.Attribute) and n.attr == read)
+                         or (isinstance(n, ast.Constant) and n.value == read)]
+                if reads and not in_key(
+                        lambda s, _r=read: (isinstance(s, ast.Attribute)
+                                            and s.attr == _r)
+                        or (isinstance(s, ast.Constant) and s.value == _r)):
+                    self.findings.append(Finding(
+                        "R2", self.path, reads[0].lineno, fn.name, read,
+                        "resolved-config read while building a cached "
+                        "program but absent from its cache key"))
+
+    # ------------------------------------------------------------ rule R3
+
+    def rule_r3(self) -> None:
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.With):
+                continue
+            for item in node.items:
+                ctx = item.context_expr
+                if not (isinstance(ctx, ast.Call)
+                        and _terminal_name(ctx.func) == "span"
+                        and ctx.args
+                        and isinstance(ctx.args[0], ast.Constant)):
+                    continue
+                name = ctx.args[0].value
+                if name not in self.config.fenced_spans:
+                    continue
+                var = item.optional_vars
+                fenced = False
+                if isinstance(var, ast.Name):
+                    for sub in node.body:
+                        for call in ast.walk(sub):
+                            if (isinstance(call, ast.Call)
+                                    and isinstance(call.func, ast.Attribute)
+                                    and call.func.attr == "fence"
+                                    and isinstance(call.func.value, ast.Name)
+                                    and call.func.value.id == var.id):
+                                fenced = True
+                if not fenced:
+                    self.findings.append(Finding(
+                        "R3", self.path, ctx.lineno,
+                        _func_qualname(node, self.parents),
+                        "span(%r)" % name,
+                        "device-work span without a .fence(...) on its "
+                        "result — it times the async dispatch, not the "
+                        "execution"))
+
+    # ------------------------------------------------------------ rule R4
+
+    def _innermost_fn(self, node: ast.AST):
+        """Innermost FunctionDef containing ``node`` (None at module
+        level) — each violation/trigger is attributed to exactly ONE
+        function, never once per enclosing level of a nested closure."""
+        for anc in _enclosing(node, self.parents):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return anc
+        return None
+
+    def rule_r4(self) -> None:
+        if not any(self.path.endswith(sfx)
+                   for sfx in self.config.traced_suffixes):
+            return
+        for node in ast.walk(self.tree):
+            fn = self._innermost_fn(node)
+            if fn is None or fn.name in self.config.host_allow:
+                continue
+            qual = _func_qualname(fn, self.parents)
+            chain = []
+            if isinstance(node, (ast.Attribute, ast.Name)):
+                # only report the OUTERMOST attribute of a chain
+                parent = self.parents.get(node)
+                if isinstance(parent, ast.Attribute):
+                    continue
+                chain = _attr_chain(node)
+            if chain and chain[0] in R4_BANNED_ROOTS:
+                self.findings.append(Finding(
+                    "R4", self.path, node.lineno, qual,
+                    ".".join(chain),
+                    "host-side construct inside a traced module — "
+                    "np/host-RNG/time values constant-fold at trace "
+                    "time (or fail on TPU)"))
+            elif chain and chain[-1] == "float64":
+                self.findings.append(Finding(
+                    "R4", self.path, node.lineno, qual,
+                    ".".join(chain),
+                    "float64 literal in traced code — the f64 path "
+                    "silently downcasts on TPU and breaks the "
+                    "bit-identity chain"))
+            elif (isinstance(node, ast.keyword)
+                  and node.arg == "dtype"
+                  and isinstance(node.value, ast.Constant)
+                  and node.value.value == "float64"):
+                self.findings.append(Finding(
+                    "R4", self.path, node.value.lineno, qual,
+                    'dtype="float64"',
+                    "float64 dtype string in traced code"))
+
+    def run(self) -> List[Finding]:
+        self.rule_r1()
+        self.rule_r2()
+        self.rule_r3()
+        self.rule_r4()
+        return self.findings
+
+
+def run_ast_rules(files: Dict[str, str],
+                  config: Optional[LintConfig] = None) -> List[Finding]:
+    """Run every AST rule over ``{path: source}``; findings sorted by
+    (path, line)."""
+    config = config or LintConfig()
+    findings: List[Finding] = []
+    for path in sorted(files):
+        findings.extend(ModuleLint(path, files[path], config).run())
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def lint_package(root: str,
+                 config: Optional[LintConfig] = None) -> List[Finding]:
+    """Walk a package directory and lint every ``.py`` beneath it."""
+    import os
+    files: Dict[str, str] = {}
+    for dirpath, _dirnames, filenames in os.walk(root):
+        if "__pycache__" in dirpath:
+            continue
+        for fname in filenames:
+            if fname.endswith(".py"):
+                full = os.path.join(dirpath, fname)
+                with open(full) as f:
+                    files[full] = f.read()
+    return run_ast_rules(files, config)

@@ -1,0 +1,89 @@
+"""graftlint driver: run both layers, apply the baseline, shape the exit.
+
+Shared by ``scripts/graftlint.py`` (the pre-merge CLI beside
+``perf_gate.py --check``) and the tier-1 pytest wrapper
+(tests/test_graftlint.py) so the gate and the test suite can never
+disagree about what "clean" means.  Exit-code contract mirrors
+perf_gate: 0 clean / 1 findings / 2 tool error.
+"""
+from __future__ import annotations
+
+import functools
+import os
+from typing import List, Optional, Tuple
+
+from .ast_rules import LintConfig, lint_package
+from .findings import Baseline, Finding, split_baseline
+
+
+class GraftlintError(Exception):
+    """Tool failure (exit 2) — distinct from findings (exit 1)."""
+
+
+def package_root() -> str:
+    """The lightgbm_tpu package directory (the AST layer's scope)."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def repo_root() -> str:
+    return os.path.dirname(package_root())
+
+
+def default_baseline_path() -> str:
+    return os.path.join(repo_root(), "GRAFTLINT_BASELINE.json")
+
+
+def run_ast_layer(root: Optional[str] = None,
+                  config: Optional[LintConfig] = None) -> List[Finding]:
+    try:
+        return lint_package(root or package_root(), config)
+    except SyntaxError as e:
+        raise GraftlintError("AST layer cannot parse %s: %s"
+                             % (getattr(e, "filename", "?"), e))
+
+
+@functools.lru_cache(maxsize=4)
+def _jaxpr_layer_cached(parallel: bool) -> Tuple[Finding, ...]:
+    """Trace + check the canonical programs ONCE per process: the traces
+    dominate the layer's cost, and the tier-1 wrapper and the census
+    cross-check tests share one session's worth."""
+    from .jaxpr_rules import (check_collective_census,
+                              check_dtype_discipline)
+    from .programs import canonical_programs, trace_program
+    findings: List[Finding] = []
+    for prog in canonical_programs(parallel=parallel):
+        jaxpr, sites = trace_program(prog)
+        findings.extend(check_dtype_discipline(
+            jaxpr, program=prog.name, feature_width=prog.feature_width,
+            bin_width=prog.bin_width))
+        findings.extend(check_collective_census(prog.name, jaxpr, sites))
+    return tuple(findings)
+
+
+def run_jaxpr_layer(parallel: bool = True) -> List[Finding]:
+    try:
+        return list(_jaxpr_layer_cached(parallel))
+    except GraftlintError:
+        raise
+    except Exception as e:
+        raise GraftlintError("jaxpr layer failed: %s: %s"
+                             % (type(e).__name__, e))
+
+
+def run(layers=("ast", "jaxpr"), baseline: Optional[Baseline] = None,
+        root: Optional[str] = None,
+        config: Optional[LintConfig] = None) -> dict:
+    """Run the requested layers and split by the baseline.  Returns
+    ``{"findings", "suppressed", "stale_baseline"}``; raises
+    GraftlintError on tool failure."""
+    findings: List[Finding] = []
+    if "ast" in layers:
+        findings.extend(run_ast_layer(root, config))
+    if "jaxpr" in layers:
+        findings.extend(run_jaxpr_layer())
+    kept, suppressed = split_baseline(findings, baseline)
+    return {
+        "findings": kept,
+        "suppressed": suppressed,
+        "stale_baseline": baseline.stale_entries() if baseline else [],
+    }

@@ -1,0 +1,126 @@
+"""Finding records, the rule catalog, and the baseline/allowlist file.
+
+A finding is one violation of one rule at one source location.  Findings
+print as ``path:line RULE symbol: message`` (the perf_gate-style one line
+per problem), and the driver exits 1 when any finding survives the
+baseline — the same contract as ``scripts/perf_gate.py --check``.
+
+Baseline (``GRAFTLINT_BASELINE.json``): pre-existing accepted sites are
+suppressed EXPLICITLY, never silently.  Every entry carries a written
+``justification`` string (printed by ``scripts/graftlint.py
+--explain-allowlist``); entries match on (rule, path suffix, symbol,
+site) — never on line numbers, which drift under unrelated edits.  An
+entry that matches nothing is itself reported (stale suppressions rot
+into silent holes), so the committed baseline can only shrink or be
+consciously re-justified.
+"""
+from __future__ import annotations
+
+import json
+from typing import List, NamedTuple, Optional
+
+# Rule catalog: id -> (title, fix hint).  The README "Static analysis"
+# section mirrors this table; tests/test_graftlint.py pins every id fires.
+RULES = {
+    "R1": ("collective-seam-coverage",
+           "wrap the seam with telemetry.collective_span(...) or call "
+           "telemetry.record_collective(...) in the same function, so the "
+           "wire-metrics inventory (ISSUE 5) sees the exchange"),
+    "R2": ("cache-key-completeness",
+           "add the resolved-config bit to the program cache key tuple "
+           "(the _key_extra/_jit_key/_PROGRAMS-key family) so a mid-process "
+           "flip retraces instead of reusing stale kernel routing"),
+    "R3": ("span-fencing",
+           "bind the span (`with telemetry.span(name) as sp:`) and pass the "
+           "device result through sp.fence(...) — an unfenced async span "
+           "times the dispatch, not the execution (the PR 7 predict bug)"),
+    "R4": ("banned-patterns-in-traced-code",
+           "traced grower/ops code must stay jnp-only: no np.*, no host "
+           "RNG, no time.*, no float64 — host-side helpers belong outside "
+           "the traced modules or on the explicit host allowlist"),
+    "J1": ("jaxpr-dtype-discipline",
+           "keep the int8 accumulator path in the integer domain until the "
+           "canonical reassembly point (no float convert before the int "
+           "psum), and never narrow ids below the global feature/bin width "
+           "(the PR 9 bf16-split-id bug)"),
+    "J2": ("jaxpr-collective-census",
+           "the collective eqns XLA will execute must match the declared "
+           "telemetry seam inventory — wrap the new collective, or remove "
+           "the stale record_collective site"),
+}
+
+
+class Finding(NamedTuple):
+    rule: str                     # rule id from RULES
+    path: str                     # repo-relative (or program-qualified)
+    line: int                     # 1-based; 0 when not line-anchored (jaxpr)
+    symbol: str                   # enclosing function / program name
+    site: str                     # what fired (e.g. "lax.psum", a site name)
+    message: str
+
+    def format(self) -> str:
+        loc = "%s:%d" % (self.path, self.line) if self.line else self.path
+        return "%s %s [%s] %s: %s — fix: %s" % (
+            loc, self.rule, self.symbol, self.site, self.message,
+            RULES[self.rule][1])
+
+    def key(self) -> tuple:
+        return (self.rule, self.path, self.symbol, self.site)
+
+
+class Baseline:
+    """Explicit suppression list.  ``match`` consumes entries so stale
+    suppressions (matching nothing by the end of a run) are reportable."""
+
+    def __init__(self, entries: Optional[List[dict]] = None):
+        self.entries = list(entries or [])
+        self._hit = [False] * len(self.entries)
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        with open(path) as f:
+            data = json.load(f)
+        if not isinstance(data, dict) or "suppressions" not in data:
+            raise ValueError("baseline %s: expected {\"suppressions\": [...]}"
+                             % path)
+        for e in data["suppressions"]:
+            missing = {"rule", "path", "symbol", "justification"} - set(e)
+            if missing:
+                raise ValueError("baseline entry %r missing %s"
+                                 % (e, sorted(missing)))
+        return cls(data["suppressions"])
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump({"version": 1, "suppressions": self.entries}, f,
+                      indent=2, sort_keys=True)
+            f.write("\n")
+
+    def matches(self, finding: Finding) -> bool:
+        for i, e in enumerate(self.entries):
+            if (e["rule"] == finding.rule
+                    and finding.path.endswith(e["path"])
+                    and e["symbol"] == finding.symbol
+                    and e.get("site", finding.site) == finding.site):
+                self._hit[i] = True
+                return True
+        return False
+
+    def stale_entries(self) -> List[dict]:
+        return [e for e, h in zip(self.entries, self._hit) if not h]
+
+    @staticmethod
+    def entry_for(finding: Finding, justification: str) -> dict:
+        return {"rule": finding.rule, "path": finding.path,
+                "symbol": finding.symbol, "site": finding.site,
+                "justification": justification}
+
+
+def split_baseline(findings: List[Finding], baseline: Optional[Baseline]):
+    """(kept, suppressed) under the baseline (None = keep everything)."""
+    if baseline is None:
+        return list(findings), []
+    kept, suppressed = [], []
+    for f in findings:
+        (suppressed if baseline.matches(f) else kept).append(f)
+    return kept, suppressed

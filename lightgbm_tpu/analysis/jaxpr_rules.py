@@ -1,0 +1,413 @@
+"""Layer 2: jaxpr invariant rules over the canonical traced programs.
+
+The AST layer proves source-level coverage; this layer proves what XLA
+will actually execute.  Programs are traced with ``jax.make_jaxpr`` (no
+compilation) and their closed jaxprs walked recursively (shard_map /
+scan / cond / while sub-jaxprs included):
+
+- **J1 jaxpr-dtype-discipline** — two checks on every (sub)jaxpr:
+  (a) *int-domain purity*: walking BACKWARD from any integer-operand
+  collective (the int8 accumulator exchange) along integer/bool value
+  vars — crossing sub-jaxpr boundaries via the loop-carry/shard_map
+  operand bindings, stopping at comparisons (selection logic is control,
+  not value) — every float->int convert on the chain must be a GENUINE
+  quantization (its float region rounds/clamps before casting); an
+  int->float convert reached first means an integer value was laundered
+  through float arithmetic and re-cast — the silent-f32-contamination
+  class that would break the serial == distributed bit-identity chain;
+  (b) *no id narrowing*: no
+  ``convert_element_type`` from a >=32-bit integer into a dtype whose
+  exact-integer capacity is below the program's global feature/bin width
+  (bf16 holds 256 consecutive ints, f16 2048, int8 127 — the PR 9
+  bf16-split-id bug as a general rule).
+- **J2 jaxpr-collective-census** — the multiset of collective eqns in
+  the jaxpr, by kind, must agree with the telemetry seam inventory
+  recorded while tracing the SAME program (``trace_census``): a kind
+  with eqns but zero declared sites is an unwrapped exchange the gated
+  wire-byte model cannot see; a declared kind with no eqns (or fewer
+  eqns than declared traces) is a stale seam record.  One telemetry
+  record may legitimately cover SEVERAL eqns (a tree-mapped allgather
+  files once for ~10 leaf gathers; quantize files one record for its
+  two scale pmaxes), so the per-kind relation is
+  ``eqns >= declared_traces`` with exact presence/absence — drift in
+  either direction is a finding.
+
+Census arming: ``begin_census()`` / ``end_census()`` (or the
+``trace_census()`` context manager) arm the telemetry registry in
+trace-census mode so ``record_collective`` files sites during the
+``make_jaxpr`` trace.  The mode is process-global like every telemetry
+state; tests/conftest.py's leak guard fails any test that leaves it
+armed (``trace_census_active()``).
+"""
+from __future__ import annotations
+
+import collections
+import contextlib
+from typing import Dict, List, Optional
+
+from .findings import Finding
+
+# jaxpr primitive name -> telemetry collective kind
+_PRIM_KINDS = {
+    "psum": "psum",
+    "psum2": "psum",
+    "reduce_scatter": "psum_scatter",
+    "psum_scatter": "psum_scatter",
+    "all_gather": "all_gather",
+    "all_to_all": "all_to_all",
+    "pmax": "pmax",
+    "pmin": "pmin",
+    "ppermute": "ppermute",
+}
+
+# exact-consecutive-integer capacity per destination dtype (J1b): the
+# largest n such that every integer in [0, n] is representable
+_INT_CAPACITY = {
+    "int8": 127, "uint8": 255, "int16": 32767, "uint16": 65535,
+    "bfloat16": 256, "float16": 2048, "float32": 1 << 24,
+    "float64": 1 << 53,
+}
+
+
+def _subjaxprs(eqn):
+    for val in eqn.params.values():
+        for item in (val if isinstance(val, (list, tuple)) else (val,)):
+            inner = getattr(item, "jaxpr", None)
+            if inner is not None and hasattr(inner, "eqns"):
+                yield inner            # ClosedJaxpr
+            elif hasattr(item, "eqns"):
+                yield item             # raw Jaxpr
+
+
+def _walk_jaxprs(jaxpr):
+    """Yield the jaxpr and every nested sub-jaxpr (shard_map / scan /
+    while / cond bodies), depth-first."""
+    jaxpr = getattr(jaxpr, "jaxpr", jaxpr)
+    yield jaxpr
+    for eqn in jaxpr.eqns:
+        for sub in _subjaxprs(eqn):
+            yield from _walk_jaxprs(sub)
+
+
+def collective_census(jaxpr) -> "collections.Counter":
+    """Multiset of collective eqns by normalized kind, all levels."""
+    census: collections.Counter = collections.Counter()
+    for jx in _walk_jaxprs(jaxpr):
+        for eqn in jx.eqns:
+            kind = _PRIM_KINDS.get(eqn.primitive.name)
+            if kind is not None:
+                census[kind] += 1
+    return census
+
+
+# --------------------------------------------------------------- J1 checks
+
+def _dtype_of(var):
+    aval = getattr(var, "aval", None)
+    return getattr(aval, "dtype", None)
+
+
+def _is_int(dt) -> bool:
+    return dt is not None and dt.kind in ("i", "u")
+
+
+def _is_float(dt) -> bool:
+    return dt is not None and dt.kind == "f"
+
+
+def _build_dataflow(jaxpr):
+    """Cross-level backward-dataflow maps: ``produced`` (id(var) ->
+    producing eqn, any level) and ``alias`` (id(sub-jaxpr invar) -> the
+    enclosing eqn's operand it binds to), so a slice can follow a value
+    INTO a scan/while/cond/shard_map body — the int8 accumulator psum
+    lives inside loop bodies while contamination can be introduced in
+    the enclosing trace and carried in.
+
+    Operand binding is positional: pjit/shard_map/closed_call and scan
+    bind sub invars 1:1 with eqn invars; cond branches bind to
+    ``invars[1:]`` (after the branch index); while bodies bind to the
+    TAIL (cond-consts precede body-consts + carry in the eqn's
+    operands).  Id-keyed throughout — jaxpr Literals are unhashable and
+    var identity is stable per trace."""
+    produced, alias = {}, {}
+
+    def visit(jx):
+        jx = getattr(jx, "jaxpr", jx)
+        for eqn in jx.eqns:
+            for var in eqn.outvars:
+                produced[id(var)] = eqn
+            for sub in _subjaxprs(eqn):
+                inner = getattr(sub, "jaxpr", sub)
+                si, oi = list(inner.invars), list(eqn.invars)
+                if len(si) == len(oi):
+                    pairs = zip(si, oi)
+                elif len(si) == len(oi) - 1:
+                    pairs = zip(si, oi[1:])
+                elif len(si) < len(oi):
+                    pairs = zip(si, oi[-len(si):])
+                else:
+                    pairs = ()
+                for s, o in pairs:
+                    alias[id(s)] = o
+                visit(inner)
+    visit(jaxpr)
+    return produced, alias
+
+
+# eqns that mark a GENUINE quantization step: a float region that rounds
+# or clamps before converting to int is quantizing by design, not
+# laundering an int value through float arithmetic
+_QUANT_MARKERS = frozenset({"round", "floor", "ceil", "clamp", "sign",
+                            "nextafter"})
+
+
+def _is_bool(dt) -> bool:
+    return dt is not None and dt.kind == "b"
+
+
+def _float_region_launders(var0, produced, alias):
+    """From the float input of a float->int convert, walk the float
+    region backward: hitting a quantization marker ends that path
+    (genuine quantize rounds/clamps before casting); hitting an
+    int->float convert FIRST means an integer value was laundered
+    through float arithmetic and re-cast — the contamination signature.
+    Returns the laundering convert's input dtype, or None."""
+    stack = [var0]
+    seen = set()
+    while stack:
+        var = stack.pop()
+        if id(var) in seen:
+            continue
+        seen.add(id(var))
+        src = produced.get(id(var))
+        if src is None:
+            outer = alias.get(id(var))
+            if outer is not None:
+                stack.append(outer)
+            continue
+        name = src.primitive.name
+        if name in _QUANT_MARKERS:
+            continue
+        if name == "convert_element_type":
+            in_dt = _dtype_of(src.invars[0])
+            if _is_int(in_dt):
+                return in_dt
+            continue   # bool->float masks and f->f widenings are benign
+        stack.extend(v for v in src.invars
+                     if not (_is_int(_dtype_of(v))
+                             or _is_bool(_dtype_of(v))))
+    return None
+
+
+# comparison eqns mark CONTROL boundaries on the int value chain: which
+# rows/leaves a reduction covers is selection logic (argmax over f32
+# gains, smaller-child count compares — f32 counts are exact integers
+# under the count lane's 1.0 scale), not the accumulator's value path
+_CMP_PRIMS = frozenset({"eq", "ne", "lt", "le", "gt", "ge"})
+
+
+def _check_int_chain(eqn, kind, produced, alias, program) -> List[Finding]:
+    """Backward slice from an integer-operand collective, following ONLY
+    integer/bool vars and stopping at comparisons (the value chain of an
+    int reduction; selection logic is control, not value).  Every
+    float->int convert on the chain is a quantization boundary whose
+    float region must quantize (round/clamp) rather than launder an int
+    value (``_float_region_launders``)."""
+    findings: List[Finding] = []
+    stack = list(eqn.invars)
+    seen = set()
+    while stack:
+        var = stack.pop()
+        if id(var) in seen:
+            continue
+        seen.add(id(var))
+        dt = _dtype_of(var)
+        if dt is not None and not (_is_int(dt) or _is_bool(dt)):
+            continue
+        src = produced.get(id(var))
+        if src is None:
+            # a sub-jaxpr invar: follow the binding out to the enclosing
+            # eqn's operand (loop carries, shard_map args)
+            outer = alias.get(id(var))
+            if outer is not None:
+                stack.append(outer)
+            continue
+        if src.primitive.name in _CMP_PRIMS:
+            continue
+        if src.primitive.name == "convert_element_type":
+            in_dt = _dtype_of(src.invars[0])
+            if _is_float(in_dt):
+                laundered = _float_region_launders(src.invars[0],
+                                                   produced, alias)
+                if laundered is not None:
+                    findings.append(Finding(
+                        "J1", program, 0, program,
+                        "convert_element_type->float32",
+                        "float conversion on the int8 accumulator path "
+                        "BEFORE the int-domain %s (%s laundered through "
+                        "float arithmetic with no quantization step) — "
+                        "the serial==distributed bit-identity chain is "
+                        "contaminated" % (kind, laundered)))
+                continue   # boundary either way
+        stack.extend(src.invars)
+    return findings
+
+
+def check_dtype_discipline(jaxpr, *, program: str, feature_width: int = 0,
+                           bin_width: int = 0) -> List[Finding]:
+    """J1 over every (sub)jaxpr level of ``jaxpr``.  ``feature_width`` /
+    ``bin_width`` are the GLOBAL widths of the traced schema — narrowing
+    is judged against them, not any owned slice (the PR 9 lesson)."""
+    findings: List[Finding] = []
+    needed = max(int(feature_width), int(bin_width))
+    produced, alias = _build_dataflow(jaxpr)
+    for jx in _walk_jaxprs(jaxpr):
+        for eqn in jx.eqns:
+            name = eqn.primitive.name
+            kind = _PRIM_KINDS.get(name)
+            # ---- (a) int-domain purity backward from int collectives
+            if kind in ("psum", "psum_scatter") and all(
+                    _is_int(_dtype_of(v)) for v in eqn.invars):
+                findings.extend(_check_int_chain(eqn, kind, produced,
+                                                 alias, program))
+            # ---- (b) id narrowing below the global feature/bin width
+            if name == "convert_element_type" and needed > 0:
+                in_dt = _dtype_of(eqn.invars[0])
+                out_dt = _dtype_of(eqn.outvars[0])
+                if (_is_int(in_dt) and in_dt.itemsize >= 4
+                        and out_dt is not None):
+                    cap = _INT_CAPACITY.get(str(out_dt))
+                    if cap is not None and needed > cap:
+                        findings.append(Finding(
+                            "J1", program, 0, program,
+                            "convert_element_type %s->%s" % (in_dt, out_dt),
+                            "integer narrowing below the global "
+                            "feature/bin width (%d > %s-exact %d) — ids "
+                            "beyond the representable range silently "
+                            "corrupt (the PR 9 bf16-split-id class)"
+                            % (needed, out_dt, cap)))
+    return findings
+
+
+# ----------------------------------------------------- trace-mode census
+
+_census_armed = False
+
+
+def trace_census_active() -> bool:
+    """True while the trace-mode telemetry arming is live — the
+    tests/conftest.py leak-guard check."""
+    return _census_armed
+
+
+def begin_census() -> None:
+    """Arm telemetry (no sink) and zero the collective registry so the
+    next ``make_jaxpr`` trace files a clean seam inventory.  Process-
+    global state: pair with ``end_census`` (prefer ``trace_census``).
+
+    REFUSES to arm over an already-enabled telemetry session: the census
+    must reset the registry to read cleanly, and resetting would destroy
+    the session's accumulated inventory (route counters, collective
+    sites, phase times) — callers running the jaxpr layer mid-training
+    must disable telemetry around it, not lose their data silently."""
+    global _census_armed
+    from .. import telemetry
+    if _census_armed:
+        raise RuntimeError("trace census already armed (unbalanced "
+                           "begin_census)")
+    if telemetry.enabled():
+        raise RuntimeError(
+            "telemetry is already enabled — the trace census would reset "
+            "(destroy) the session's accumulated registry; disable "
+            "telemetry before running the graftlint jaxpr layer")
+    telemetry.enable()
+    telemetry.reset()
+    _census_armed = True
+
+
+def end_census() -> Dict[str, dict]:
+    """Collect the seam inventory recorded since ``begin_census`` and
+    return telemetry to its resting (disabled) state."""
+    global _census_armed
+    from .. import telemetry
+    sites = telemetry.collectives()
+    telemetry.disable()
+    telemetry.reset()
+    _census_armed = False
+    return sites
+
+
+@contextlib.contextmanager
+def trace_census():
+    """``with trace_census() as holder: jaxpr = jax.make_jaxpr(fn)(*args)``
+    — afterwards ``holder.sites`` is the recorded seam inventory."""
+    class _Holder:
+        sites: Dict[str, dict] = {}
+    holder = _Holder()
+    begin_census()
+    try:
+        yield holder
+    finally:
+        holder.sites = end_census()
+
+
+def traced_inventory(fn, *args) -> "tuple[object, Dict[str, dict]]":
+    """Trace ``fn(*args)`` under the census: returns (closed_jaxpr,
+    telemetry seam inventory recorded during that trace)."""
+    import jax
+    with trace_census() as holder:
+        jaxpr = jax.make_jaxpr(fn)(*args)
+    return jaxpr, holder.sites
+
+
+def declared_census(sites: Dict[str, dict]) -> "collections.Counter":
+    """Telemetry seam inventory -> declared {kind: traced_calls} multiset.
+    Sites filed with the grower-generic kind ``"reduce"`` (wrap_schedule's
+    fallback label for custom learners) are counted under a wildcard key
+    that matches any reduction kind."""
+    declared: collections.Counter = collections.Counter()
+    for rec in sites.values():
+        declared[rec.get("kind", "reduce")] += int(rec.get("traced_calls", 1))
+    return declared
+
+
+# kinds a generic ``kind="reduce"`` site (wrap_schedule's fallback label)
+# may legitimately stand in for — NEVER an all_gather/all_to_all/ppermute
+_REDUCTION_KINDS = frozenset({"psum", "psum_scatter", "pmax", "pmin"})
+
+
+def check_collective_census(program: str, jaxpr,
+                            sites: Dict[str, dict]) -> List[Finding]:
+    """J2: jaxpr collective census vs the declared seam inventory."""
+    actual = collective_census(jaxpr)
+    declared = declared_census(sites)
+    generic = declared.pop("reduce", 0)
+    findings: List[Finding] = []
+    for kind, n in sorted(actual.items()):
+        if declared.get(kind, 0) == 0 and not (
+                generic and kind in _REDUCTION_KINDS):
+            findings.append(Finding(
+                "J2", program, 0, program, kind,
+                "%d %s eqn(s) in the traced program but ZERO declared "
+                "telemetry sites — the wire-byte model cannot see this "
+                "exchange" % (n, kind)))
+    if generic and not any(actual.get(k, 0) for k in _REDUCTION_KINDS):
+        findings.append(Finding(
+            "J2", program, 0, program, "reduce",
+            "declared %d generic reduce site call(s) but the jaxpr "
+            "contains no reduction eqns — a stale seam record misprices "
+            "the wire series" % generic))
+    for kind, n in sorted(declared.items()):
+        have = actual.get(kind, 0)
+        if have == 0:
+            findings.append(Finding(
+                "J2", program, 0, program, kind,
+                "declared %d traced %s site call(s) but the jaxpr "
+                "contains none — a stale seam record misprices the "
+                "wire series" % (n, kind)))
+        elif have < n:
+            findings.append(Finding(
+                "J2", program, 0, program, kind,
+                "jaxpr has %d %s eqn(s) but %d declared traced calls — "
+                "declared traces exceed what XLA executes" % (have, kind, n)))
+    return findings

@@ -1,0 +1,191 @@
+"""Canonical small-schema programs for the jaxpr layer.
+
+One home for the traced-program inventory the J-rules run over: the
+serial grow policies, the int8 histogram exchange, the serving BFS walk,
+and the (2,2)-mesh parallel learners (data / hybrid / voting) — the same
+program family ``__graft_entry__.dryrun_multichip`` exercises, at a
+schema small enough that every trace stays inside the tier-1 budget
+(``jax.make_jaxpr`` only TRACES; nothing compiles or executes).
+
+Each entry is ``(name, fn, args, axis_env, meta)`` where ``meta`` carries
+the GLOBAL feature/bin widths the J1 narrowing check judges against.
+Parallel programs are built from the learners' own shard closures
+(``learner._grow_fn`` — the exact seam construction production training
+uses) wrapped in ``shard_map`` over the learner's own mesh, so the
+census is of the REAL programs, not a re-implementation.
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, NamedTuple, Optional
+
+# small-schema constants: big enough that every seam exists (multiple
+# splits, multiple features per owned block), small enough to trace in
+# milliseconds
+F, N, B, LEAVES = 12, 256, 16, 8
+
+
+class Program(NamedTuple):
+    name: str
+    fn: object
+    args: tuple
+    axis_env: tuple          # for make_jaxpr on unmapped collectives
+    feature_width: int
+    bin_width: int
+
+
+def _small_data(seed: int = 0):
+    import numpy as np
+    import jax.numpy as jnp
+    rng = np.random.RandomState(seed)
+    bins = jnp.asarray(rng.randint(0, B, size=(F, N)).astype(np.int8))
+    grad = jnp.asarray(rng.randn(N).astype(np.float32))
+    hess = jnp.asarray((rng.rand(N) + 0.1).astype(np.float32))
+    row_mask = jnp.ones((N,), jnp.bool_)
+    fmask = jnp.ones((F,), jnp.bool_)
+    nbins = jnp.full((F,), B, jnp.int32)
+    return bins, grad, hess, row_mask, fmask, nbins
+
+
+def _grow_kwargs(compute_dtype="float32"):
+    return dict(num_leaves=LEAVES, num_bins_max=B, min_data_in_leaf=4,
+                min_sum_hessian_in_leaf=0.1, max_depth=-1,
+                compute_dtype=compute_dtype)
+
+
+def _serial_program(policy: str, compute_dtype: str) -> Program:
+    from ..models.grower_unified import grow_tree_unified
+    kwargs = _grow_kwargs(compute_dtype)
+    if policy == "leafcompact":
+        kwargs["use_pallas_partition"] = False
+    fn = functools.partial(grow_tree_unified, policy=policy, **kwargs)
+    return Program("grow/serial_%s_%s" % (policy, compute_dtype), fn,
+                   _small_data(), (), F, B)
+
+
+def _hist_int8_dp_program() -> Program:
+    """The int8 histogram exchange under a data axis: quantize (scale
+    pmax) + int-domain accumulator psum — the bit-identity chain J1
+    exists to protect."""
+    from ..ops.histogram import build_histogram
+    from ..parallel.mesh import DATA_AXIS
+    bins, grad, hess, row_mask, _fm, _nb = _small_data()
+    fn = functools.partial(build_histogram, num_bins_max=B,
+                           backend="matmul", chunk=64,
+                           compute_dtype="int8", axis_name=DATA_AXIS)
+    return Program("hist/int8_dp", fn, (bins, grad, hess, row_mask),
+                   ((DATA_AXIS, 2),), F, B)
+
+
+def _serving_programs() -> "List[Program]":
+    import numpy as np
+    import jax.numpy as jnp
+    from ..ops.scoring import bfs_scores_impl, bfs_scores_int8_impl
+    rng = np.random.RandomState(3)
+    T, max_nodes, max_leaves, depth = 3, 4, 5, 3
+    codes = jnp.asarray(rng.randint(0, B, size=(F, N)).astype(np.int32))
+    sf = jnp.asarray(rng.randint(0, F, size=(T, max_nodes)).astype(np.int32))
+    tr = jnp.asarray(rng.randint(0, B, size=(T, max_nodes)).astype(np.int32))
+    # chain trees: node k -> left leaf ~k, right node k+1 (last: leaf)
+    lc = jnp.asarray(np.tile(~np.arange(max_nodes), (T, 1)).astype(np.int32))
+    rc_row = np.arange(1, max_nodes + 1)
+    rc_row[-1] = ~max_nodes
+    rc = jnp.asarray(np.tile(rc_row, (T, 1)).astype(np.int32))
+    leaf_value = jnp.asarray(rng.randn(T, max_leaves).astype(np.float32))
+    root_state = jnp.zeros((T,), jnp.int32)
+    tree_class = jnp.zeros((T,), jnp.int32)
+    f32 = Program(
+        "serve/bfs_f32",
+        functools.partial(bfs_scores_impl, max_depth=depth, num_class=1),
+        (codes, sf, tr, lc, rc, leaf_value, root_state, tree_class),
+        (), F, B)
+    leaf_q = jnp.asarray(rng.randint(-127, 128,
+                                     size=(T, max_leaves)).astype(np.int8))
+    scale = jnp.asarray((rng.rand(T) + 0.5).astype(np.float32))
+    int8 = Program(
+        "serve/bfs_int8",
+        functools.partial(bfs_scores_int8_impl, max_depth=depth,
+                          num_class=1),
+        (codes, sf, tr, lc, rc, leaf_q, scale, root_state, tree_class),
+        (), F, B)
+    return [f32, int8]
+
+
+def parallel_grow_program(tree_learner: str, hist_dtype: str = "float32",
+                          num_machines: int = 4, feature_shards: int = 2,
+                          top_k: int = 2) -> Program:
+    """The (2,2)-mesh grow program of a parallel learner, built from the
+    learner's OWN shard closure (``_grow_fn``) and mesh — what
+    ``dryrun_multichip``'s data/hybrid/voting rows execute, minus the
+    jit/booster scaffolding the census does not need."""
+    import jax
+    from types import SimpleNamespace
+    from jax.sharding import PartitionSpec as P
+    from ..config import OverallConfig
+    from ..parallel import create_parallel_learner
+    from ..parallel import learners as learners_mod
+    from ..parallel.mesh import DATA_AXIS
+
+    if len(jax.devices()) < num_machines:
+        raise RuntimeError(
+            "jaxpr layer needs %d devices (set XLA_FLAGS="
+            "--xla_force_host_platform_device_count=8 before importing "
+            "jax, as scripts/graftlint.py and tests/conftest.py do)"
+            % num_machines)
+    cfg = OverallConfig()
+    params = {"objective": "binary", "num_leaves": str(LEAVES),
+              "min_data_in_leaf": "4", "min_sum_hessian_in_leaf": "0.1",
+              "learning_rate": "0.1", "tree_learner": tree_learner,
+              "num_machines": str(num_machines), "hist_dtype": hist_dtype}
+    if tree_learner in ("hybrid", "voting"):
+        params["feature_shards"] = str(feature_shards)
+    if tree_learner == "voting":
+        params["top_k"] = str(top_k)
+    cfg.set(params, require_data=False)
+    learner = create_parallel_learner(cfg)
+    mesh = learner._mesh()
+    num_shards = int(mesh.shape[DATA_AXIS])
+    fake_gbdt = SimpleNamespace(num_bins_max=B, _pack_spec=None)
+    kwargs = learner._grow_kwargs(fake_gbdt)
+    shard_fn = learner._grow_fn(kwargs, F, num_shards)
+    mapped = learners_mod.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(None, DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+                  P(DATA_AXIS), P(), P()),
+        out_specs=learners_mod._tree_out_specs(DATA_AXIS))
+    name = "grow/%s_leafwise_%s" % (tree_learner, hist_dtype)
+    return Program(name, mapped, _small_data(), (), F, B)
+
+
+def canonical_programs(parallel: bool = True) -> "List[Program]":
+    """The full inventory.  ``parallel=False`` restricts to programs that
+    need no multi-device platform (serial + serving + the axis_env hist
+    exchange)."""
+    programs = [
+        _serial_program("leafwise", "float32"),
+        _serial_program("leafwise", "int8"),
+        _serial_program("depthwise", "float32"),
+        _serial_program("leafcompact", "float32"),
+        _hist_int8_dp_program(),
+    ]
+    programs.extend(_serving_programs())
+    if parallel:
+        programs.extend([
+            parallel_grow_program("data"),
+            parallel_grow_program("data", hist_dtype="int8"),
+            parallel_grow_program("hybrid"),
+            parallel_grow_program("voting"),
+        ])
+    return programs
+
+
+def trace_program(prog: Program):
+    """(closed_jaxpr, telemetry seam inventory) for one program — the
+    census-armed trace both J-rules consume."""
+    import jax
+    from .jaxpr_rules import trace_census
+    with trace_census() as holder:
+        jaxpr = jax.make_jaxpr(prog.fn,
+                               axis_env=list(prog.axis_env) or None)(
+            *prog.args)
+    return jaxpr, holder.sites

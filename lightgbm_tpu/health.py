@@ -108,6 +108,14 @@ def health_vector(grad, hess, score, *, quantized: bool = False,
     smax = jnp.max(jnp.where(finite, jnp.abs(score), 0.0))
     vec_counts = jnp.stack(counts)
     if axis_name is not None:
+        # wire-metrics coverage (ISSUE 5 / graftlint R1): tiny payloads,
+        # but a full collective latency each — they belong in the
+        # interconnect inventory like every other seam
+        from . import telemetry
+        telemetry.record_collective("health/vector_psum", "psum", axis_name,
+                                    telemetry._tree_nbytes(vec_counts))
+        telemetry.record_collective("health/score_pmax", "pmax", axis_name,
+                                    telemetry._tree_nbytes(smax))
         vec_counts = jax.lax.psum(vec_counts, axis_name)
         smax = jax.lax.pmax(smax, axis_name)
     return jnp.concatenate([vec_counts, qsat[None], smax[None]])

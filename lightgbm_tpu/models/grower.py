@@ -4,8 +4,12 @@ The three grower modules were collapsed into ONE schedule-parameterized
 grower (ISSUE 9): growth policy (leafwise/depthwise/leafcompact) and a
 declarative :class:`~.grower_unified.SeamSchedule` are parameters there;
 this module keeps the historical leaf-wise entry points (``grow_tree``,
-``grow_tree_impl`` with keyword seams, ``grow_tree_segmented``) and the
-shared ``TreeArrays``/``_GrowState`` types.  New code should import from
+``grow_tree_impl`` with keyword seams, ``grow_tree_segmented``) plus the
+patchable ``build_histogram`` attribute, and nothing else — the graftlint
+AST pass (ISSUE 10) proved the old ``BIG``/``TreeArrays``/``_GrowState``/
+``_grow_init``/``_grow_segment`` re-exports unreferenced outside
+``grower_unified`` itself, and tests/test_graftlint.py pins this surface
+so dead exports cannot regrow.  New code should import from
 ``grower_unified`` directly.
 """
 from __future__ import annotations
@@ -17,8 +21,7 @@ import jax.numpy as jnp
 from ..ops.histogram import build_histogram  # noqa: F401
 
 from .grower_unified import (  # noqa: F401
-    BIG, SeamSchedule, TreeArrays, _GrowState, _grow_init, _grow_segment,
-    grow_tree, grow_tree_segmented, grow_tree_unified)
+    SeamSchedule, grow_tree, grow_tree_segmented, grow_tree_unified)
 
 
 def grow_tree_impl(bins, grad, hess, row_mask, feature_mask, num_bins, *,

@@ -3,7 +3,9 @@
 The three grower modules were collapsed into ONE schedule-parameterized
 grower (ISSUE 9); this module keeps the historical depth-wise entry
 points (``grow_tree_depthwise`` with keyword seams, the module-level
-``grow_tree_depthwise_jit``, ``num_levels``).  New code should import
+``grow_tree_depthwise_jit``, ``num_levels``) plus the patchable
+``histogram_leafbatch`` attribute, and nothing else (graftlint-proved
+surface, pinned by tests/test_graftlint.py).  New code should import
 from ``grower_unified`` directly.
 """
 from __future__ import annotations
@@ -16,8 +18,7 @@ import jax.numpy as jnp
 from ..ops.histogram import histogram_leafbatch  # noqa: F401
 
 from .grower_unified import (  # noqa: F401
-    BIG, SeamSchedule, TreeArrays, grow_tree_depthwise_jit,
-    grow_tree_unified, num_levels)
+    SeamSchedule, grow_tree_depthwise_jit, grow_tree_unified, num_levels)
 
 
 def grow_tree_depthwise(bins, grad, hess, row_mask, feature_mask,
@@ -29,9 +30,10 @@ def grow_tree_depthwise(bins, grad, hess, row_mask, feature_mask,
                         partition_bins=None, hist_axis=None,
                         compute_dtype=jnp.float32, packing=None,
                         hist_reduce_level=None, int_reduce_level=None,
-                        own_slice=None) -> TreeArrays:
+                        own_slice=None):
     """Historical keyword-seam surface over
-    ``grow_tree_unified(policy="depthwise")``."""
+    ``grow_tree_unified(policy="depthwise")``; returns a
+    ``grower_unified.TreeArrays``."""
     schedule = SeamSchedule(
         hist_axis=hist_axis, hist_reduce=hist_reduce,
         stat_reduce=stat_reduce, own_slice=own_slice,

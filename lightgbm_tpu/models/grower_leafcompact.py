@@ -4,7 +4,9 @@
 The three grower modules were collapsed into ONE schedule-parameterized
 grower (ISSUE 9); this module keeps the historical compact entry points
 (``grow_tree_leafcompact_impl`` with keyword seams, the module-level
-``grow_tree_leafcompact``).  New code should import from
+``grow_tree_leafcompact``) plus the patchable ``build_histogram``
+attribute, and nothing else (graftlint-proved surface, pinned by
+tests/test_graftlint.py).  New code should import from
 ``grower_unified`` directly.
 """
 from __future__ import annotations
@@ -16,8 +18,7 @@ import jax.numpy as jnp
 from ..ops.histogram import build_histogram  # noqa: F401
 
 from .grower_unified import (  # noqa: F401
-    SeamSchedule, TreeArrays, _CompactState, grow_tree_leafcompact,
-    grow_tree_unified)
+    SeamSchedule, grow_tree_leafcompact, grow_tree_unified)
 
 
 def grow_tree_leafcompact_impl(bins, grad, hess, row_mask, feature_mask,

@@ -1386,9 +1386,15 @@ class FeatureParallelLearner(_ParallelLearnerBase):
             from ..health import make_health_fn
             health_fn = make_health_fn(
                 self.tree_config.hist_dtype == "int8", None)
+        # backend + device_type join the key like the DP/serial chunk
+        # caches (graftlint R2): num_shards alone cannot distinguish two
+        # same-sized meshes on different backends, and trace-time kernel
+        # routing (ops/histogram._pallas_hist_ok, LGBM_TPU_NO_PALLAS
+        # flips) bakes the backend into the program
         key = (obj_key, id(grad_fn), num_shards, num_class, lr,
                self._depthwise, tuple(sorted(kwargs.items())), has_bag,
-               has_ff, bool(health),
+               has_ff, bool(health), jax.default_backend(),
+               getattr(self.config, 'device_type', ''),
                tuple(id(f) for f in train_metric_fns),
                tuple(tuple(id(f) for f in fns) for fns in valid_metric_fns))
         prog = _FP_CHUNK_PROGRAMS.get(key)

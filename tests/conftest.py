@@ -47,6 +47,16 @@ def _telemetry_leak_guard():
     # of its configured path (an unmerged shard surviving the test)
     leaked_watchdog = telemetry.watchdog_active()
     leaked_timeline = telemetry.timeline_enabled()
+    # ISSUE 10 surface: graftlint's jaxpr layer arms telemetry in
+    # trace-census mode (analysis.jaxpr_rules.begin_census) to record
+    # the seam inventory while tracing; a test that leaves it armed
+    # makes every later record_collective land in a foreign census AND
+    # leaves telemetry enabled.  Check BEFORE the disable below (the
+    # census teardown owns its own telemetry restore).
+    from lightgbm_tpu.analysis import jaxpr_rules as _graftlint_census
+    leaked_census = _graftlint_census.trace_census_active()
+    if leaked_census:
+        _graftlint_census.end_census()
     telemetry.disable()
     telemetry.reset()
     # ISSUE 9 surface: a test that enters ``with mesh:`` and leaks it
@@ -66,11 +76,14 @@ def _telemetry_leak_guard():
     except (ImportError, AttributeError):  # pragma: no cover - jax drift
         pass
     assert not (leaked_enabled or leaked_sink or leaked_watchdog
-                or leaked_timeline or leaked_mesh is not None), (
-        "test left %s — clean up (telemetry.disable() / exit the mesh "
-        "context, or use a fixture) so state cannot leak between tests"
+                or leaked_timeline or leaked_census
+                or leaked_mesh is not None), (
+        "test left %s — clean up (telemetry.disable() / end_census() / "
+        "exit the mesh context, or use a fixture) so state cannot leak "
+        "between tests"
         % ("telemetry with a live watchdog thread" if leaked_watchdog
            else "telemetry in timeline/shard mode" if leaked_timeline
+           else "graftlint trace-census armed" if leaked_census
            else "telemetry enabled with an open sink" if leaked_sink
            else "telemetry enabled" if leaked_enabled
            else "a global mesh context installed (%r)" % (leaked_mesh,)))
