@@ -533,6 +533,16 @@ def main() -> int:
                         help="mixed-bin feature packing (per-bin-width-"
                              "class histogram passes); auto = on whenever "
                              "the table mixes narrow and wide features")
+    parser.add_argument("--tree-learner", default="serial",
+                        choices=["serial", "data", "hybrid", "voting"],
+                        help="train the headline on a parallel learner "
+                             "over a simulated 4-device CPU mesh "
+                             "(hybrid/voting: (2,2) with "
+                             "feature_shards=2) — the "
+                             "mixedbin_hybrid_iters_per_sec lane runs "
+                             "hybrid with mixed_bin=true so the gated "
+                             "series carries the composed "
+                             "packing-on-the-2-D-mesh configuration")
     parser.add_argument("--pipeline", default="readback",
                         choices=["readback", "off"],
                         help="pipelined boosting: double-buffer the next "
@@ -584,6 +594,11 @@ def main() -> int:
                   f"(f32 dispatch watchdog, see BASELINE.md)",
                   file=sys.stderr)
             args.iters = safe
+
+    device_type = ""
+    if args.tree_learner != "serial":
+        import __graft_entry__ as graft
+        device_type = graft._provision_devices(4)
 
     import jax
     import lightgbm_tpu as lgb
@@ -663,13 +678,24 @@ def main() -> int:
             split_s = args.rows * per_row
             segs = max(1, math.ceil((args.leaves - 1) * split_s / 30.0))
             params["leafwise_segments"] = str(segs)
+        if args.tree_learner != "serial":
+            params.update({"tree_learner": args.tree_learner,
+                           "num_machines": "4",
+                           "device_type": device_type})
+            if args.tree_learner in ("hybrid", "voting"):
+                params["feature_shards"] = "2"
         cfg = OverallConfig()
         cfg.set(params, require_data=False)
 
         booster = GBDT()
         objective = create_objective(cfg.objective_type,
                                      cfg.objective_config)
-        booster.init(cfg.boosting_config, ds, objective)
+        learner = None
+        if args.tree_learner != "serial":
+            from lightgbm_tpu.parallel import create_parallel_learner
+            learner = create_parallel_learner(cfg)
+        booster.init(cfg.boosting_config, ds, objective, learner=learner)
+        run_config.mixed_bin_on = booster._pack_spec is not None
 
         # leaf-wise runs per-iteration: a fused leaf-wise chunk is one
         # dispatch of k x 254 histogram passes, which is both slower than
@@ -734,6 +760,7 @@ def main() -> int:
         booster.flush_pipeline()
         return samples, booster.health_summary()
 
+    run_config.mixed_bin_on = False
     samples, health_summary = run_config(args.grow_policy, args.hist_dtype,
                                          args.iters)
     iters_per_sec = float(np.median(samples))
@@ -752,6 +779,13 @@ def main() -> int:
             iters_per_sec / reference_iters_per_sec(args.rows), 4),
         "vs_cuda": round(iters_per_sec / cuda_iters_per_sec(args.rows), 4),
         "cuda_anchor_iters_per_sec": cuda_iters_per_sec(args.rows),
+        # mixed-bin resolution record (ISSUE 12): scripts/perf_gate.py
+        # flags a hybrid/voting round whose config requested auto/true
+        # but whose booster silently resolved the uniform layout
+        "tree_learner": args.tree_learner,
+        "mixed_bin_requested": args.mixed_bin,
+        "mixedbin_expected": narrow > 0,
+        "mixed_bin_on": bool(run_config.mixed_bin_on),
     }
     if len(samples) > 1 or max(1, args.repeats) > 1:
         # emit even when rounds were dropped (no-splittable-leaf early
@@ -908,6 +942,28 @@ def main() -> int:
                   [("mixedbin_iters_per_sec", "value"),
                    ("mixedbin_vs_cuda", "vs_cuda"),
                    ("mixedbin_spread", "spread")])
+
+    if run_mixedbin and args.tree_learner == "serial":
+        # the COMPOSED configuration (ISSUE 12): block-local mixed-bin
+        # packing ON the 2-D hybrid mesh, pinned explicitly — the gated
+        # mixedbin_hybrid_iters_per_sec lane plus the resolution record
+        # perf_gate's absolute mixed-bin check reads (a silent fallback
+        # to the uniform layout fails the gate, not just the trajectory)
+        sub_bench("mixedbin_hybrid",
+                  ["--max-bin", str(args.max_bin),
+                   "--iters", str(args.iters),
+                   "--grow-policy", args.grow_policy,
+                   "--hist-dtype", args.hist_dtype,
+                   "--mixed-bin", "true",
+                   "--tree-learner", "hybrid"],
+                  [("mixedbin_hybrid_iters_per_sec", "value"),
+                   ("mixedbin_hybrid_spread", "spread"),
+                   ("mixedbin_hybrid_tree_learner", "tree_learner"),
+                   ("mixedbin_hybrid_mixed_bin_requested",
+                    "mixed_bin_requested"),
+                   ("mixedbin_hybrid_mixedbin_expected",
+                    "mixedbin_expected"),
+                   ("mixedbin_hybrid_mixed_bin_on", "mixed_bin_on")])
 
     run_predict = not args.skip_parity
     if run_predict:

@@ -49,8 +49,12 @@ COLLECTIVE_NAMES = ("psum", "psum_scatter", "all_gather", "pmax", "pmin",
 RESOLVED_CONFIG_CALLS = ("partition_overlap_on", "pallas_partition_ok",
                          "default_backend", "leafwise_compact_on")
 # Resolved-config READS by attribute/constant name (same rule): the
-# device-steering knob __graft_entry__ flips between virtual meshes.
-RESOLVED_CONFIG_READS = ("device_type",)
+# device-steering knob __graft_entry__ flips between virtual meshes, and
+# the booster's resolved mixed-bin layout spec (``_pack_spec``, a plain
+# or BLOCK-LOCAL PackSpec since ISSUE 12) — a traced program bakes the
+# per-class histogram pass structure in, so a cached program built while
+# reading it must thread the spec (or its digest) into the key.
+RESOLVED_CONFIG_READS = ("device_type", "_pack_spec")
 
 # Span names that time asynchronous device work and therefore must fence
 # their results (R3).  Host-side spans (eval, model_readback — a blocking

@@ -54,8 +54,16 @@ a host full-N draw + upload; the RNG STREAM differs from the host
 path, so trees differ by the sampling draw only;
 ``LGBM_TPU_HOST_BAGGING=1`` is the A/B hatch) and ``goss`` +
 ``top_rate``/``other_rate`` (gradient-based one-side sampling, run
-entirely on device; incompatible with bagging and multi-process
-training).  ``streaming``/``ingest_chunk_rows``/``bagging_device`` are
+entirely on device; incompatible with bagging; traced INSIDE the fused
+chunk programs since ISSUE 12 — sampled iterations keep the fused-k
+dispatch on serial, data/hybrid/voting and feature-parallel learners,
+and multi-process GOSS is supported on the chunk path,
+grow_policy=depthwise).  ``mixed_bin`` composes with
+``tree_learner=hybrid|voting`` via the block-local layout (the class
+permutation never crosses an ownership block boundary; degenerates to
+uniform, with a warning under ``mixed_bin=true``, when an ownership
+block has no narrow feature).
+``streaming``/``ingest_chunk_rows``/``bagging_device`` are
 model-invariant; ``goss`` changes the trained model by design.
 """
 from __future__ import annotations

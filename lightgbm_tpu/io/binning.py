@@ -227,6 +227,131 @@ class PackSpec(NamedTuple):
         return tuple(inv)
 
 
+class BlockedPackSpec(NamedTuple):
+    """Block-local mixed-bin layout for feature-block ownership meshes
+    (ISSUE 12): the bin-width-class permutation is computed PER owned
+    feature block of width ``block`` and never crosses a block boundary,
+    so packing COMMUTES with contiguous feature-block ownership — the
+    storage positions of ownership block ``b`` are exactly the canonical
+    positions ``[b*block, (b+1)*block)``, only the inner order changes.
+    The owned-block psum / psum_scatter and the packed-SplitInfo
+    allreduce therefore ride unchanged, and the hybrid/voting learners
+    no longer force the uniform layout.
+
+    SPMD constraint: every shard traces ONE program, so the per-block
+    class counts must be identical across blocks.  ``counts`` is the
+    per-block split ``(narrow, block - narrow)`` with ``narrow`` = the
+    MINIMUM narrow-feature count over all blocks; each block stores its
+    first ``narrow`` narrow features (canonical order, stable) in the
+    narrow segment and everything else — surplus narrow features
+    included — in the wide segment at the full width (a narrow feature
+    histogrammed at the wide width is value-identical: its bins beyond
+    ``num_bin`` are zero either way).  The plan degenerates to None
+    (uniform layout) when the narrowest block contributes no narrow
+    feature — see :func:`plan_feature_packing_blocked`.
+
+    widths : per-class histogram width ``(narrow_bins, num_bins_max)``
+    counts : per-BLOCK features per class ``(c_n, block - c_n)``
+    block  : the ownership block width ``Fb = ceil(F / feature_shards)``
+    perm   : packed storage position -> canonical feature (global, len F;
+             a concatenation of within-block permutations)
+    """
+    widths: tuple
+    counts: tuple
+    block: int
+    perm: tuple
+
+    @property
+    def num_features(self) -> int:
+        return len(self.perm)
+
+    @property
+    def c2p(self) -> tuple:
+        """Canonical feature -> packed storage position (global)."""
+        inv = [0] * len(self.perm)
+        for p, f in enumerate(self.perm):
+            inv[f] = p
+        return tuple(inv)
+
+    @property
+    def ranges(self):
+        """Global per-class ``(start, count, width)`` segments in packed
+        storage order: per-block interleaved ``narrow`` then ``wide``
+        segments (the full-F histogram routes run one pass per segment
+        and reassemble via ``c2p`` — the generic PackSpec contract)."""
+        F = len(self.perm)
+        c_n = self.counts[0]
+        out = []
+        for start in range(0, F, self.block):
+            width = min(self.block, F - start)
+            if c_n:
+                out.append((start, c_n, self.widths[0]))
+            if width > c_n:
+                out.append((start + c_n, width - c_n, self.widths[1]))
+        return tuple(out)
+
+    @property
+    def block_view(self) -> PackSpec:
+        """The per-owned-block view the SHARDED histogram passes use: the
+        sliced ``[Fb, N]`` owned block is already in packed order and
+        STAYS in packed order (identity perm) — feature identity is
+        restored at the split finder's storage->canonical remap, so the
+        pass structure is shard-uniform (SPMD) even though each block's
+        inner permutation differs."""
+        return PackSpec(widths=self.widths,
+                        counts=(self.counts[0],
+                                self.block - self.counts[0]),
+                        perm=tuple(range(self.block)))
+
+
+def plan_feature_packing_blocked(num_bins, num_bins_max: int,
+                                 block: int,
+                                 mode: str = "auto",
+                                 narrow_bins: int = NARROW_BINS,
+                                 shards: int = 0
+                                 ) -> Optional[BlockedPackSpec]:
+    """Block-local mixed-bin plan for a contiguous feature-block
+    ownership layout (``block`` = the per-shard block width, ``shards``
+    the feature-shard count when known).  Returns None — the uniform
+    layout — when packing cannot help or cannot hold: single global
+    class (same rule as :func:`plan_feature_packing`), a shard that owns
+    ONLY ownership padding (``block * (shards-1) >= F``: its clamped
+    duplicate lanes would land a wide feature in the narrow segment —
+    garbage outside the masked lanes, but the degenerate mesh isn't
+    worth serving), or a narrowest block with no narrow feature (the
+    uniform per-block class counts would be ``(0, block)`` — one
+    class)."""
+    if mode == "false" or os.environ.get("LGBM_TPU_NO_MIXEDBIN", "") == "1":
+        return None
+    nb = np.asarray(num_bins)
+    F = nb.size
+    if F == 0 or num_bins_max <= narrow_bins or block <= 0:
+        return None
+    if shards > 1 and block * (shards - 1) >= F:
+        return None
+    narrow = nb <= narrow_bins
+    if not narrow.any() or narrow.all():
+        return None
+    starts = list(range(0, F, block))
+    c_n = min(int(narrow[s:s + block].sum()) for s in starts)
+    if c_n == 0:
+        return None
+    perm = []
+    for s in starts:
+        width = min(block, F - s)
+        local = np.arange(s, s + width)
+        is_n = narrow[s:s + width]
+        first_n = local[is_n][:c_n]
+        rest = np.array([f for f in local if f not in set(first_n)],
+                        dtype=np.int64)
+        perm.extend(int(i) for i in np.concatenate([first_n, rest]))
+    return BlockedPackSpec(
+        widths=(int(narrow_bins), int(num_bins_max)),
+        counts=(int(c_n), int(block - c_n)),
+        block=int(block),
+        perm=tuple(perm))
+
+
 def plan_feature_packing(num_bins, num_bins_max: int,
                          mode: str = "auto",
                          narrow_bins: int = NARROW_BINS

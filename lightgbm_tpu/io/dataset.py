@@ -602,17 +602,29 @@ class Dataset:
     def num_features(self) -> int:
         return len(self.bin_mappers)
 
-    def plan_packing(self, mode: str = "auto"):
+    def plan_packing(self, mode: str = "auto", block: int = 0,
+                     shards: int = 0):
         """Mixed-bin layout plan for THIS dataset's per-feature bin counts
         (io/binning.plan_feature_packing): the bin-width-class partition a
         booster uses to reorder the bin matrix at attach time.  None when
         packing cannot help (single class) or is disabled.  The Dataset
         itself stays canonical — validation sets, tree replay and the
         binary cache all speak canonical feature order; only a training
-        booster's device copy of ``bins`` is reordered."""
-        from .binning import plan_feature_packing
+        booster's device copy of ``bins`` is reordered.
+
+        ``block`` > 0: the BLOCK-LOCAL plan for a contiguous feature-block
+        ownership layout (the hybrid/voting 2-D mesh learners,
+        io/binning.plan_feature_packing_blocked) — the permutation never
+        crosses an ownership block boundary, so packing commutes with
+        block ownership."""
+        from .binning import (plan_feature_packing,
+                              plan_feature_packing_blocked)
         if not len(self.bin_mappers):
             return None
+        if block > 0:
+            return plan_feature_packing_blocked(
+                self.num_bins, int(self.num_bins.max()), block, mode=mode,
+                shards=shards)
         return plan_feature_packing(self.num_bins,
                                     int(self.num_bins.max()), mode=mode)
 

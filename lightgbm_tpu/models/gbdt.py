@@ -143,23 +143,57 @@ class GBDT:
         if (learner is not None
                 and (type(learner).__name__ == "FeatureParallelLearner"
                      or getattr(learner, "needs_uniform_layout", False))):
-            # feature ownership (feature-parallel slices; hybrid/voting
-            # contiguous blocks) and the class-contiguous packed layout
-            # do not compose
+            # feature-parallel ownership slices are ARBITRARY (bin-count
+            # balanced) feature subsets — no contiguous-block structure a
+            # packed layout could commute with
             if mixed_mode == "true":
                 log.warning("mixed_bin is not supported by %s; "
                             "keeping the uniform layout"
                             % type(learner).__name__)
+        elif (learner is not None
+                and getattr(learner, "feature_block_packing", False)):
+            # hybrid/voting 2-D mesh (ISSUE 12): the bin-width-class
+            # permutation is computed PER owned feature block — it never
+            # crosses a block boundary, so packing commutes with block
+            # ownership and the owned-block psum / packed-SplitInfo
+            # allreduce ride unchanged (io/binning.BlockedPackSpec)
+            blk, fs = learner.pack_layout(train_data.num_features)
+            self._pack_spec = train_data.plan_packing(
+                mode=mixed_mode, block=blk, shards=fs)
+            if self._pack_spec is None and mixed_mode == "true":
+                log.warning(
+                    "mixed_bin=true requested but the block-local plan "
+                    "degenerates to the uniform layout (single bin-width "
+                    "class, or an ownership block without narrow "
+                    "features)")
         else:
             self._pack_spec = train_data.plan_packing(mode=mixed_mode)
         if self._pack_spec is not None:
+            blocked = hasattr(self._pack_spec, "block")
             telemetry.count_route("hist_layout", "hist/mixedbin_on")
-            log.info("mixed-bin packing: %d narrow (<=%d bins) + %d wide "
-                     "features (histogram passes per class: %s)"
-                     % (self._pack_spec.counts[0],
-                        self._pack_spec.widths[0],
-                        self._pack_spec.counts[1],
-                        "x".join(str(w) for w in self._pack_spec.widths)))
+            if blocked:
+                # the block-local variant files an extra marker so the
+                # route counters distinguish the layouts (telemetry.py
+                # hist/mixedbin_* family)
+                telemetry.count("hist/mixedbin_blocked")
+            if blocked:
+                log.info("mixed-bin packing (block-local, block=%d): %d "
+                         "narrow (<=%d bins) + %d wide features PER "
+                         "owned block (histogram passes per class: %s)"
+                         % (self._pack_spec.block,
+                            self._pack_spec.counts[0],
+                            self._pack_spec.widths[0],
+                            self._pack_spec.counts[1],
+                            "x".join(str(w)
+                                     for w in self._pack_spec.widths)))
+            else:
+                log.info("mixed-bin packing: %d narrow (<=%d bins) + %d "
+                         "wide features (histogram passes per class: %s)"
+                         % (self._pack_spec.counts[0],
+                            self._pack_spec.widths[0],
+                            self._pack_spec.counts[1],
+                            "x".join(str(w)
+                                     for w in self._pack_spec.widths)))
         else:
             telemetry.count_route("hist_layout", "hist/mixedbin_off")
 
@@ -313,16 +347,29 @@ class GBDT:
         # remainder, fed through the row-mask seam (ops/sampling.py)
         self._goss_on = bool(getattr(boosting_config, "goss", False))
         if self._goss_on:
-            if self._host_inputs:
-                log.fatal("goss=true is not supported in multi-process "
-                          "training in this revision (the device "
-                          "selection runs over the local row layout)")
+            if self._host_inputs and self.tree_config.grow_policy \
+                    != "depthwise":
+                # multi-process GOSS rides the fused chunk program only
+                # (the selection is traced in-program over the gathered
+                # global gradient scores); the per-iteration multi-
+                # process path would run the device draw over committed
+                # local arrays and is not supported
+                log.fatal(
+                    "goss=true in multi-process training requires the "
+                    "fused chunk path: grow_policy=depthwise (and a "
+                    "device formulation for every configured metric); "
+                    "per-iteration multi-process GOSS is unsupported")
             from ..ops import sampling as _sampling
             self._goss_key = _sampling.bag_key(
                 boosting_config.bagging_seed)
+            # selection runs over the GLOBAL true rows in every mode
+            # (the DP chunk gathers scores and selects on the compacted
+            # global layout — identical to the serial draw)
+            sel_n = self._mp_true_n if self._mp else N
             (self._goss_top_cnt, self._goss_other_cnt,
              self._goss_amp) = _sampling.goss_counts(
-                N, boosting_config.top_rate, boosting_config.other_rate)
+                sel_n, boosting_config.top_rate,
+                boosting_config.other_rate)
             log.info("GOSS: keeping top %d rows by |grad| + %d amplified "
                      "(x%.3f) random rows per iteration"
                      % (self._goss_top_cnt, self._goss_other_cnt,
@@ -578,6 +625,12 @@ class GBDT:
         Returns ``(grad, hess, None)`` untouched when GOSS is off."""
         if not self._goss_on:
             return grad, hess, None
+        if self._host_inputs:
+            # defensive: init() fatals unless the chunk path will serve
+            # multi-process GOSS; a direct per-iteration call must not
+            # silently run the draw over committed local arrays
+            log.fatal("per-iteration multi-process GOSS is unsupported; "
+                      "use the fused chunk path (grow_policy=depthwise)")
         from ..ops import sampling as _sampling
         with telemetry.span("goss") as sp:
             g, h, mask = _sampling.goss_select(
@@ -1061,6 +1114,12 @@ class GBDT:
             log.fatal("multi-process feature-parallel training requires "
                       "the fused chunk path: grow_policy=depthwise and a "
                       "device formulation for every configured metric")
+        if self._mp and self._goss_on and not self.chunkable_for(is_eval):
+            # multi-process GOSS exists only inside the chunk program
+            # (the selection gathers the global gradient scores there)
+            log.fatal("goss=true in multi-process training requires the "
+                      "fused chunk path: grow_policy=depthwise and a "
+                      "device formulation for every configured metric")
         # hung-collective flight recorder (ISSUE 5): with stall_timeout=
         # configured, a watchdog thread records span/collective events in
         # a ring buffer and — if no event lands for the timeout — dumps
@@ -1218,13 +1277,15 @@ class GBDT:
         all_gathered global score inside the shard_map chunk; AUC's
         global sort included.  Validation sets ride replicated).
 
-        GOSS (ISSUE 8) excludes chunking in this revision: the fused scan
-        computes gradients in-program, but the GOSS selection must run on
-        each iteration's raw gradients BEFORE the grower sees them — a
-        per-iteration seam the chunk body does not expose yet.  GOSS runs
-        stay on the per-iteration path (run_training falls through)."""
-        if getattr(self, "_goss_on", False):
-            return False
+        GOSS (ISSUE 12) runs INSIDE the chunk program on every path:
+        the selection is traced into the scan body on each iteration's
+        raw in-program gradients (serial/FP: the full replicated rows;
+        DP: the |grad| scores all_gathered over the data axis, selected
+        on the compacted true rows, sliced back per shard — a pure
+        function of the globally-identical gradients, so every shard
+        computes the identical selection), so sampled iterations keep
+        the fused-k dispatch instead of forcing the per-iteration
+        path."""
         if self.supports_chunking:
             return True
         from ..parallel.learners import (DataParallelLearner,
@@ -1359,6 +1420,13 @@ class GBDT:
                        [[] for _ in self.valid_metrics])
         from ..parallel.learners import FeatureParallelLearner
         fp = isinstance(self._learner, FeatureParallelLearner)
+        # in-chunk GOSS (ISSUE 12): the static selection parameters ride
+        # the program builders (and their cache keys); the per-iteration
+        # key stream fold_in(PRNGKey(seed), iteration) matches the
+        # per-iteration path's _goss_masks draw exactly
+        goss = ((int(self.gbdt_config.bagging_seed), self._goss_top_cnt,
+                 self._goss_other_cnt, float(self._goss_amp))
+                if self._goss_on else None)
         if dp:
             extra = {} if fp else {
                 "needs_global_score": getattr(self.objective,
@@ -1366,6 +1434,7 @@ class GBDT:
             if self._mp:
                 extra["shard_layout"] = self._shard_layout
             extra["health"] = mon is not None
+            extra["goss"] = goss
             fn, num_shards = self._learner.chunk_program(
                 self, obj_key, grad_fn, obj_params, has_bag, has_ff,
                 train_metric_fns=tuple(s[2] for s in train_specs),
@@ -1396,7 +1465,8 @@ class GBDT:
                 valid_metric_fns=tuple(tuple(s[2] for s in specs)
                                        for specs in valid_specs),
                 health_fn=(mon.chunk_health_fn(None)
-                           if mon is not None else None))
+                           if mon is not None else None),
+                goss=goss)
 
         C, N, F = self.num_class, self.num_data, self.num_features
         # snapshots for early/degenerate stops and tail truncation: training
@@ -1413,6 +1483,17 @@ class GBDT:
         prev_rec = self._pipe_chunk
         base_iter = self.iter + (prev_rec["planned"]
                                  if prev_rec is not None else 0)
+        # in-chunk GOSS key stream: global iteration numbers ride the
+        # scan xs (fold_in(PRNGKey(seed), iteration) in-program — the
+        # rollback machinery needs NO snapshot, the draw is a pure
+        # function of the iteration)
+        if goss is not None:
+            goss_iters = (np.asarray if self._host_inputs else jnp.asarray)(
+                np.arange(base_iter, base_iter + k, dtype=np.int32))
+            goss_args = (goss_iters,)
+            telemetry.count("goss/iterations", k)
+        else:
+            goss_args = ()
 
         # multi-process runs keep replicated inputs host-side (every process
         # passes identical values; a committed local jnp array would clash
@@ -1485,7 +1566,7 @@ class GBDT:
                     train_in,
                     tuple(e["bins"] for e in self.valid_datasets),
                     tuple(e["score"] for e in self.valid_datasets),
-                    valid_in))
+                    valid_in, *goss_args))
             self.score = new_score
         elif dp:
             # pad rows to the shard grid once per booster; padded rows are
@@ -1527,7 +1608,7 @@ class GBDT:
                     tuple(e["bins"] for e in self.valid_datasets),
                     tuple(e["score"] for e in self.valid_datasets),
                     tuple(tuple(s[1] for s in specs)
-                          for specs in valid_specs)))
+                          for specs in valid_specs), *goss_args))
             self.score = new_score[:, :N] if pad else new_score
         else:
             with telemetry.span("train_chunk") as sp:
@@ -1538,7 +1619,7 @@ class GBDT:
                     tuple(e["bins"] for e in self.valid_datasets),
                     tuple(e["score"] for e in self.valid_datasets),
                     tuple(tuple(s[1] for s in specs)
-                          for specs in valid_specs)))
+                          for specs in valid_specs), *goss_args))
         # post-chunk valid scores install NOW (the next dispatch reads
         # them); stop paths rebuild from valid_before absolutely, so the
         # early install is semantics-neutral
@@ -2133,7 +2214,7 @@ def make_chunk_body(*, grad_fn, obj_params, num_class: int, lrf, grow_fn,
                     base_mask=None, max_nodes: int = 1,
                     valid_bins=(), valid_mparams=(),
                     train_metric_fns=(), train_mparams=(),
-                    valid_metric_fns=(), health_fn=None):
+                    valid_metric_fns=(), health_fn=None, goss_fn=None):
     """The per-iteration boosting body shared by the serial chunk program
     and the data-parallel shard_map chunk (parallel/learners.py):
     gradients → per-class grow → train-score update (+ valid-score replay
@@ -2144,25 +2225,47 @@ def make_chunk_body(*, grad_fn, obj_params, num_class: int, lrf, grow_fn,
     ``health_fn`` (health.make_health_fn) accumulates the per-iteration
     training-health vector in-program — the fused chunk is the only place
     those per-iteration values exist; the vector is pure extra reductions
-    over the existing arrays, never fed back into them."""
+    over the existing arrays, never fed back into them.
+
+    ``goss_fn`` (ISSUE 12): in-program GOSS selection — called as
+    ``(iteration, grad, hess) -> (grad', hess', mask)`` on each
+    iteration's RAW gradients before the per-class grows, exactly where
+    the per-iteration path runs ``gbdt._goss_masks``.  The selection
+    mask replaces the bagging row mask (GOSS excludes bagging by config)
+    and the amplified grad'/hess' feed the growers; health and the next
+    iteration's gradients keep the raw arrays.  When set, the scan xs
+    carry a third element: the per-iteration GLOBAL iteration numbers
+    (the GOSS key stream is ``fold_in(PRNGKey(seed), iteration)``, same
+    as the per-iteration path — fused == per-iteration selection is
+    bit-identical)."""
     F, N = bins.shape
     n_valid = len(valid_bins)
 
     def body(carry, xs):
         score, vscores = carry
-        rmask, fmask = xs
+        if goss_fn is None:
+            rmask, fmask = xs
+        else:
+            rmask, fmask, goss_it = xs
         grad, hess = grad_fn(obj_params,
                              score if num_class > 1 else score[0])
         if num_class == 1:
             grad, hess = grad[None], hess[None]
+        if goss_fn is not None:
+            g_grow, h_grow, goss_mask = goss_fn(goss_it, grad, hess)
+        else:
+            g_grow, h_grow, goss_mask = grad, hess, None
         outs = []
         vscores = list(vscores)
         ones = (base_mask if base_mask is not None
                 else jnp.ones((N,), jnp.bool_))
         for cls in range(num_class):
-            rm = (rmask[cls] & ones) if has_bag else ones
+            if goss_mask is not None:
+                rm = goss_mask & ones
+            else:
+                rm = (rmask[cls] & ones) if has_bag else ones
             fm = fmask[cls] if has_ff else jnp.ones((F,), jnp.bool_)
-            ta = grow_fn(bins, grad[cls], hess[cls], rm, fm, num_bins)
+            ta = grow_fn(bins, g_grow[cls], h_grow[cls], rm, fm, num_bins)
             shrunk = jnp.where(ta.num_leaves > 1, ta.leaf_value * lrf, 0.0)
             score = score.at[cls].add(_leaf_lookup(shrunk, ta.leaf_ids))
             # valid scores by tree replay (gbdt.cpp:220-222)
@@ -2203,7 +2306,7 @@ def _get_chunk_program(obj_key, grad_fn, num_class: int, lr: float,
                        has_bag: bool, has_ff: bool,
                        train_metric_fns: tuple = (),
                        valid_metric_fns: tuple = (),
-                       health_fn=None):
+                       health_fn=None, goss=None):
     # the RESOLVED pallas-partition/DMA-overlap bits (and the backend
     # identity) are part of the key: __graft_entry__ flips
     # LGBM_TPU_NO_PALLAS mid-process (PROFILE.md's A/B flips
@@ -2216,7 +2319,7 @@ def _get_chunk_program(obj_key, grad_fn, num_class: int, lr: float,
            num_bins_max, min_data_in_leaf, min_sum_hessian_in_leaf,
            max_depth, hist_chunk, hist_dtype, quant_rounding,
            leafwise_compact, use_pp, use_pp and partition_overlap_on(),
-           packing,
+           packing, goss,
            jax.default_backend(), has_bag, has_ff,
            tuple(id(f) for f in train_metric_fns),
            tuple(tuple(id(f) for f in fns) for fns in valid_metric_fns),
@@ -2247,9 +2350,11 @@ def _get_chunk_program(obj_key, grad_fn, num_class: int, lr: float,
         from .grower import grow_tree_impl as grow
     lrf = jnp.float32(lr)
     max_nodes = max(num_leaves - 1, 1)
+    goss_fn = make_goss_fn(goss) if goss is not None else None
 
     def chunk_fn(score, bins, num_bins, row_masks, feat_masks, obj_params,
-                 train_mparams, valid_bins, valid_scores, valid_mparams):
+                 train_mparams, valid_bins, valid_scores, valid_mparams,
+                 goss_iters=None):
         body = make_chunk_body(
             grad_fn=grad_fn, obj_params=obj_params, num_class=num_class,
             lrf=lrf,
@@ -2258,9 +2363,12 @@ def _get_chunk_program(obj_key, grad_fn, num_class: int, lr: float,
             max_nodes=max_nodes, valid_bins=valid_bins,
             valid_mparams=valid_mparams,
             train_metric_fns=train_metric_fns, train_mparams=train_mparams,
-            valid_metric_fns=valid_metric_fns, health_fn=health_fn)
+            valid_metric_fns=valid_metric_fns, health_fn=health_fn,
+            goss_fn=goss_fn)
+        xs = ((row_masks, feat_masks) if goss_fn is None
+              else (row_masks, feat_masks, goss_iters))
         (score, vscores), (stacked, mvals, hvals) = jax.lax.scan(
-            body, (score, tuple(valid_scores)), (row_masks, feat_masks))
+            body, (score, tuple(valid_scores)), xs)
         return score, vscores, stacked, mvals, hvals
 
     from .. import costmodel
@@ -2268,6 +2376,27 @@ def _get_chunk_program(obj_key, grad_fn, num_class: int, lr: float,
                                 phase="train_chunk")
     _CHUNK_PROGRAMS[key] = prog
     return prog
+
+
+def make_goss_fn(goss):
+    """In-program GOSS selection over FULL rows (the serial chunk scan
+    and the feature-parallel chunk, whose rows are replicated): the
+    per-iteration ``_goss_masks`` draw traced into the chunk body.
+    ``goss`` is the static ``(seed, top_cnt, other_cnt, amp)`` tuple;
+    the key stream is ``fold_in(PRNGKey(seed), iteration)`` — exactly
+    the per-iteration path's, so fused == per-iteration selection is
+    bit-identical.  The data-parallel variant (gathered global scores,
+    padded-row layouts) lives in parallel/learners.chunk_program."""
+    seed, top_cnt, other_cnt, amp = goss
+    from ..ops import sampling as _sampling
+
+    def goss_fn(it, grad, hess):
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), it)
+        mask, w = _sampling.goss_mask_weights(
+            key, _sampling.goss_row_scores(grad), top_cnt, other_cnt,
+            amp)
+        return grad * w, hess * w, mask
+    return goss_fn
 
 
 def _tuning_kwargs(hist_chunk: int, hist_dtype: str,

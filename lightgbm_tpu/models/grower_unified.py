@@ -108,6 +108,18 @@ class SeamSchedule(NamedTuple):
     hist_reduce_level: Optional[object] = None
     int_reduce_level: Optional[object] = None
     hist_local: bool = False
+    # TRACED [F] storage->canonical gather indices handed to every
+    # histogram build (ops/histogram feat_gather): the block-local
+    # mixed-bin layout's owned slice is built in PACKED order, and the
+    # kernels gather it back to canonical order IN THE INT DOMAIN (before
+    # dequantize/psum), so the cache, root stats, subtraction and split
+    # search are all canonical and the downstream f32 graph is
+    # shape-identical to the uniform layout's — packed-vs-uniform stays
+    # bit-identical including argmax tie-breaks and XLA FMA-contraction
+    # choices (ISSUE 12; learners derive it from the shard rank, so the
+    # SPMD program is shard-uniform even though each block's permutation
+    # differs)
+    hist_feat_gather: Optional[object] = None
 
 
 _SERIAL = SeamSchedule()
@@ -258,6 +270,7 @@ def _depth_gated(res: SplitResult, depth, max_depth: int) -> SplitResult:
 _GROW_STATICS = ("policy", "num_leaves", "num_bins_max", "min_data_in_leaf",
                  "min_sum_hessian_in_leaf", "max_depth", "hist_backend",
                  "hist_chunk", "compute_dtype", "packing",
+                 "partition_packing",
                  "use_pallas_partition", "partition_overlap", "interpret")
 
 
@@ -266,7 +279,7 @@ def grow_tree_unified(bins, grad, hess, row_mask, feature_mask, num_bins,
                       min_data_in_leaf: int, min_sum_hessian_in_leaf: float,
                       max_depth: int = -1, hist_backend: str = "matmul",
                       hist_chunk: int = 0, compute_dtype=jnp.float32,
-                      packing=None,
+                      packing=None, partition_packing=None,
                       use_pallas_partition: bool = False,
                       partition_overlap: bool = True,
                       interpret: bool = False,
@@ -295,6 +308,13 @@ def grow_tree_unified(bins, grad, hess, row_mask, feature_mask, num_bins,
         return GLOBAL feature indices
     hist_chunk : row-chunk length of the histogram scan; 0 = the
         policy's default (16384 leaf-wise/compact, 65536 depthwise)
+    packing / partition_packing : mixed-bin layout specs.  ``packing``
+        describes the layout of ``bins`` (the histogram passes);
+        ``partition_packing`` (default: ``packing``) the layout of
+        ``partition_bins`` — they differ under the block-local ownership
+        layout (io/binning.BlockedPackSpec), where the owned slice uses
+        the shard-uniform ``block_view`` while splits apply on the full
+        blocked storage matrix via the GLOBAL canonical->storage map
     use_pallas_partition / partition_overlap / interpret : the compact
         policy's partition-kernel routing (ops/compact.partition_segment)
     init_state / loop_count / return_state : the leaf-wise policy's
@@ -313,7 +333,10 @@ def grow_tree_unified(bins, grad, hess, row_mask, feature_mask, num_bins,
                   min_data_in_leaf=min_data_in_leaf,
                   min_sum_hessian_in_leaf=min_sum_hessian_in_leaf,
                   max_depth=max_depth, hist_chunk=hist_chunk,
-                  compute_dtype=compute_dtype, packing=packing)
+                  compute_dtype=compute_dtype, packing=packing,
+                  partition_packing=(partition_packing
+                                     if partition_packing is not None
+                                     else packing))
     if policy == "depthwise":
         if return_state or init_state is not None:
             raise ValueError("dispatch segmentation is a leafwise seam")
@@ -363,8 +386,8 @@ def _grow_leafwise(bins, grad, hess, row_mask, feature_mask, num_bins,
                    num_bins_max: int, min_data_in_leaf: int,
                    min_sum_hessian_in_leaf: float, max_depth: int,
                    hist_backend: str, hist_chunk: int, compute_dtype,
-                   packing, init_state=None, loop_count=None,
-                   return_state: bool = False):
+                   packing, partition_packing=None, init_state=None,
+                   loop_count=None, return_state: bool = False):
     """Masked leaf-wise growth (the reference's TreeLearner::Train,
     serial_tree_learner.cpp:119-153): DataPartition's permuted index
     lists become a [N] leaf-id vector, the LRU histogram pool a dense
@@ -378,6 +401,8 @@ def _grow_leafwise(bins, grad, hess, row_mask, feature_mask, num_bins,
     build_hist = _patchable("grower", "build_histogram", build_histogram)
     if partition_bins is None:
         partition_bins = bins
+    _fg = ({"feat_gather": s.hist_feat_gather}
+           if s.hist_feat_gather is not None else {})
 
     def hist_of(mask, salt=0):
         hist = build_hist(bins, grad, hess, mask, B,
@@ -385,7 +410,7 @@ def _grow_leafwise(bins, grad, hess, row_mask, feature_mask, num_bins,
                                compute_dtype=compute_dtype,
                                axis_name=s.hist_axis,
                                int_reduce=s.int_hist_reduce, salt=salt,
-                               packing=packing)
+                               packing=packing, **_fg)
         return _apply_hist_reduce(hist, s, compute_dtype)
 
     def best_of(hist, sum_g, sum_h, cnt, depth, root=False):
@@ -402,7 +427,8 @@ def _grow_leafwise(bins, grad, hess, row_mask, feature_mask, num_bins,
             lambda: build_hist(bins, grad, hess, row_mask, B,
                                backend=hist_backend, chunk=hist_chunk,
                                compute_dtype=compute_dtype,
-                               axis_name=s.hist_axis, packing=packing),
+                               axis_name=s.hist_axis, packing=packing,
+                               **_fg),
             lambda: hist_of(row_mask), s, compute_dtype)
         root_stats = _root_stats_of(full, s, compute_dtype, grad, hess,
                                     row_mask)
@@ -481,7 +507,7 @@ def _grow_leafwise(bins, grad, hess, row_mask, feature_mask, num_bins,
             # --- partition rows (DataPartition::Split as masked where,
             # data_partition.hpp:93-139), split feature translated through
             # the storage-layout map (partition-index-translate seam)
-            pfeat = partition_feature(packing, feat)
+            pfeat = partition_feature(partition_packing, feat)
             fbin = jax.lax.dynamic_index_in_dim(
                 partition_bins, pfeat, axis=0, keepdims=False).astype(jnp.int32)
             go_right = fbin > thr
@@ -596,7 +622,8 @@ def _grow_depthwise(bins, grad, hess, row_mask, feature_mask, num_bins,
                     s: SeamSchedule, partition_bins, *, num_leaves: int,
                     num_bins_max: int, min_data_in_leaf: int,
                     min_sum_hessian_in_leaf: float, max_depth: int,
-                    hist_chunk: int, compute_dtype, packing) -> TreeArrays:
+                    hist_chunk: int, compute_dtype, packing,
+                    partition_packing=None) -> TreeArrays:
     """Depth-wise (level-batched) growth — the TPU throughput path: the
     histograms of ALL leaves of a level build in ONE leaf-batched matmul
     pass (3·P value columns fill the MXU; 8 batched passes for a 255-leaf
@@ -628,6 +655,8 @@ def _grow_depthwise(bins, grad, hess, row_mask, feature_mask, num_bins,
         # (histogram_leafbatch_segsum, test/profiling stubs) don't take
         # them
         extra = {"int_reduce": int_red} if int_red is not None else {}
+        if s.hist_feat_gather is not None:
+            extra["feat_gather"] = s.hist_feat_gather
         if salt and compute_dtype == "int8_sr":
             extra["salt"] = salt
         out = leafbatch(b, g, h, col_id, col_ok, C, B,
@@ -765,7 +794,7 @@ def _grow_depthwise(bins, grad, hess, row_mask, feature_mask, num_bins,
             # mixed-bin packing stores the matrix rows in packed order;
             # the per-slot partition feature must address that layout
             # (the recorded split_feature above stays canonical)
-            feat_part = partition_feature(packing, res.feature)
+            feat_part = partition_feature(partition_packing, res.feature)
             table = jnp.stack([feat_part.astype(f32),
                                res.threshold.astype(f32),
                                chosen.astype(f32),
@@ -904,7 +933,8 @@ def _grow_leafcompact(bins, grad, hess, row_mask, feature_mask, num_bins,
                       num_bins_max: int, min_data_in_leaf: int,
                       min_sum_hessian_in_leaf: float, max_depth: int,
                       hist_backend: str, hist_chunk: int, compute_dtype,
-                      packing, use_pallas_partition: bool,
+                      packing, partition_packing=None,
+                      use_pallas_partition: bool,
                       partition_overlap: bool, interpret: bool,
                       return_state: bool = False):
     """Compacted leaf-wise growth — reference-parity split order at the
@@ -930,8 +960,9 @@ def _grow_leafcompact(bins, grad, hess, row_mask, feature_mask, num_bins,
     L = num_leaves
     B = num_bins_max
     f32 = jnp.float32
-    c2p_arr = (jnp.asarray(packing.c2p, jnp.int32)
-               if packing is not None and len(packing.widths) > 1 else None)
+    ppack = partition_packing if partition_packing is not None else packing
+    c2p_arr = (jnp.asarray(ppack.c2p, jnp.int32)
+               if ppack is not None and len(ppack.widths) > 1 else None)
     table = bucket_table(N, min_width=max(BLOCK, (-(-N // BLOCK) * BLOCK)
                                           >> 9))
     P = table[0]
@@ -944,6 +975,8 @@ def _grow_leafcompact(bins, grad, hess, row_mask, feature_mask, num_bins,
 
     build_hist = _patchable("grower_leafcompact", "build_histogram",
                             build_histogram)
+    _fg = ({"feat_gather": s.hist_feat_gather}
+           if s.hist_feat_gather is not None else {})
 
     def hist_of(hbins, hg, hh, hmask, salt=0):
         hist = build_hist(hbins, hg, hh, hmask, B,
@@ -951,7 +984,7 @@ def _grow_leafcompact(bins, grad, hess, row_mask, feature_mask, num_bins,
                                compute_dtype=compute_dtype,
                                axis_name=s.hist_axis,
                                int_reduce=s.int_hist_reduce, salt=salt,
-                               packing=packing)
+                               packing=packing, **_fg)
         return _apply_hist_reduce(hist, s, compute_dtype)
 
     finder = s.split_finder or find_best_split
@@ -992,7 +1025,7 @@ def _grow_leafcompact(bins, grad, hess, row_mask, feature_mask, num_bins,
         lambda: build_hist(bins, grad, hess, row_mask, B,
                            backend=hist_backend, chunk=hist_chunk,
                            compute_dtype=compute_dtype,
-                           axis_name=s.hist_axis, packing=packing),
+                           axis_name=s.hist_axis, packing=packing, **_fg),
         lambda: hist_of(bins, grad, hess, row_mask), s, compute_dtype)
     root_stats = _root_stats_of(full, s, compute_dtype, grad, hess,
                                 row_mask)

@@ -346,7 +346,8 @@ def hist_pallas_leafbatch(bins, grad, hess, col_id, col_ok, num_cols: int,
                           num_bins_max: int, *, chunk: int = 2048,
                           dtype: str = "int8", rng_bits=None,
                           axis_name=None, int_reduce=None,
-                          stochastic=False, salt=0, packing=None):
+                          stochastic=False, salt=0, packing=None,
+                          feat_gather=None):
     """Drop-in histogram_leafbatch equivalent on the Pallas kernel.
 
     ``bins`` is the usual [F, N] matrix (int8 or uint8).  The int32
@@ -370,13 +371,13 @@ def hist_pallas_leafbatch(bins, grad, hess, col_id, col_ok, num_cols: int,
             num_cols, num_bins_max, group_width=64, chunk=chunk,
             dtype=dtype, rng_bits=rng_bits, axis_name=axis_name,
             int_reduce=int_reduce, stochastic=stochastic, salt=salt,
-            packing=packing))
+            packing=packing, feat_gather=feat_gather))
 
 
 def _hist_pallas_one(bins, grad, hess, col_id, col_ok, num_cols, B, *,
                      chunk, dtype, rng_bits, axis_name=None,
                      int_reduce=None, stochastic=False, salt=0,
-                     packing=None):
+                     packing=None, feat_gather=None):
     F, N = bins.shape
     lanes = LANES if num_cols <= 42 else 192
     # ONE quantization for every class pass: the scale comes from the same
@@ -405,6 +406,15 @@ def _hist_pallas_one(bins, grad, hess, col_id, col_ok, num_cols, B, *,
         acc = hist_pallas_raw(bins.astype(jnp.int8), packed, B=B,
                               chunk=chunk, dtype=dtype,
                               lanes=lanes)                   # [F, B, lanes]
+    if feat_gather is not None:
+        # block-local packing's storage->canonical reorder, IN the int
+        # domain and BEFORE the cross-shard reduction: the gather
+        # commutes with the elementwise int psum, and the dequantized
+        # f32 graph downstream is shape-identical to the uniform
+        # layout's (XLA contraction choices cannot diverge — ISSUE 12)
+        assert int_reduce is None, \
+            "feat_gather does not compose with the ownership int scatter"
+        acc = jnp.take(acc, feat_gather, axis=0)
     if int_reduce is not None:
         # ownership schedule: psum_scatter the INT accumulators by feature
         # block (feature axis 0) — still int-domain, still bit-exact
@@ -533,7 +543,8 @@ def _hist_float_one(bins, grad, hess, col_id, col_ok, num_cols, B, *,
 def hist_quant_xla(bins, grad, hess, col_id, col_ok, num_cols: int,
                    num_bins_max: int, *, chunk: int = 65536, rng_bits=None,
                    axis_name=None, int_reduce=None,
-                   stochastic=False, salt=0, packing=None):
+                   stochastic=False, salt=0, packing=None,
+                   feat_gather=None):
     """XLA reference of the SAME quantized-gradient math as the Pallas int8
     kernel (bit-identical output) — the CPU-testable oracle and the
     fallback on non-TPU backends.  ``packing``: per-class int accumulators
@@ -547,6 +558,7 @@ def hist_quant_xla(bins, grad, hess, col_id, col_ok, num_cols: int,
             _hist_quant_xla_one, bins, grad, hess, col_id, col_ok,
             num_cols, num_bins_max, chunk=chunk, rng_bits=rng_bits,
             axis_name=axis_name, int_reduce=int_reduce,
+            feat_gather=feat_gather,
             stochastic=stochastic, salt=salt, packing=packing))
 
 
@@ -578,7 +590,8 @@ def _quant_xla_acc(bins, vals, cid, B: int, C: int, chunk: int):
 
 def _hist_quant_xla_one(bins, grad, hess, col_id, col_ok, num_cols, B, *,
                         chunk, rng_bits, axis_name=None, int_reduce=None,
-                        stochastic=False, salt=0, packing=None):
+                        stochastic=False, salt=0, packing=None,
+                        feat_gather=None):
     F, N = bins.shape
     C = num_cols
     # don't pad a small input up to a full default chunk
@@ -602,6 +615,12 @@ def _hist_quant_xla_one(bins, grad, hess, col_id, col_ok, num_cols, B, *,
         hist = _class_acc_assemble(parts, packing, B)    # [F, B, C*3] i32
     else:
         hist = _quant_xla_acc(bins, vals, cid, B, C, chunk)
+    if feat_gather is not None:
+        # storage->canonical reorder IN the int domain, before the
+        # cross-shard psum (commutes elementwise) — see _hist_pallas_one
+        assert int_reduce is None, \
+            "feat_gather does not compose with the ownership int scatter"
+        hist = jnp.take(hist, feat_gather, axis=0)
     if int_reduce is not None:
         hist = int_reduce(hist)                # int-domain feature scatter
         F = hist.shape[0]

@@ -159,10 +159,24 @@ def _note_hist_pass(bins, num_cols: int, num_bins_max: int,
         macs=macs, bytes_moved=bytes_moved)
 
 
+def _feat_take(hist, feat_gather, axis: int):
+    """Apply the traced storage->canonical feature gather (block-local
+    mixed-bin packing, ISSUE 12).  For float accumulators the placement
+    is free — every cell is a finished sum — and for the quantized paths
+    the gather runs IN THE INT DOMAIN inside the kernel drivers
+    (ops/hist_pallas), so the dequantize->search f32 graph is
+    shape-identical to the uniform layout's and XLA's FMA-contraction
+    choices cannot diverge between the two programs."""
+    if feat_gather is None:
+        return hist
+    return jnp.take(hist, feat_gather, axis=axis)
+
+
 def histogram_matmul(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                      mask: jax.Array, num_bins_max: int,
                      chunk: int = 16384,
-                     compute_dtype=jnp.float32, packing=None) -> jax.Array:
+                     compute_dtype=jnp.float32, packing=None,
+                     feat_gather=None) -> jax.Array:
     """Build per-feature histograms for the masked row subset.
 
     Parameters
@@ -193,10 +207,12 @@ def histogram_matmul(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                 parts.append(_histogram_matmul_impl(
                     jax.lax.slice_in_dim(bins, start, start + cnt, axis=0),
                     grad, hess, mask, width, eff_chunk, compute_dtype))
-            return sp.fence(_assemble_classes(
-                parts, packing, num_bins_max, feat_axis=0, bin_axis=1))
-        return sp.fence(_histogram_matmul_impl(
-            bins, grad, hess, mask, num_bins_max, chunk, compute_dtype))
+            return sp.fence(_feat_take(_assemble_classes(
+                parts, packing, num_bins_max, feat_axis=0, bin_axis=1),
+                feat_gather, 0))
+        return sp.fence(_feat_take(_histogram_matmul_impl(
+            bins, grad, hess, mask, num_bins_max, chunk, compute_dtype),
+            feat_gather, 0))
 
 
 def _histogram_matmul_impl(bins, grad, hess, mask, num_bins_max, chunk,
@@ -271,7 +287,8 @@ def histogram_leafbatch(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                         num_bins_max: int, chunk: int = 65536,
                         compute_dtype=jnp.bfloat16,
                         axis_name=None, int_reduce=None,
-                        salt=0, packing=None) -> jax.Array:
+                        salt=0, packing=None,
+                        feat_gather=None) -> jax.Array:
     """Build histograms for MANY leaves in ONE matmul pass.
 
     The single-leaf one-hot matmul starves the MXU: the value operand has
@@ -318,13 +335,14 @@ def histogram_leafbatch(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                     bins, grad, hess, col_id, col_ok, num_cols,
                     num_bins_max, axis_name=axis_name,
                     int_reduce=int_reduce, stochastic=stochastic,
-                    salt=salt, packing=packing))
+                    salt=salt, packing=packing, feat_gather=feat_gather))
         telemetry.count("hist/xla_int8")
         with telemetry.span("histogram") as sp:
             return sp.fence(hist_quant_xla(
                 bins, grad, hess, col_id, col_ok, num_cols, num_bins_max,
                 chunk=chunk, axis_name=axis_name, int_reduce=int_reduce,
-                stochastic=stochastic, salt=salt, packing=packing))
+                stochastic=stochastic, salt=salt, packing=packing,
+                feat_gather=feat_gather))
     # float dtypes on TPU: hand-scheduled Pallas kernel with bf16 operands
     # (f32 rides a hi/lo operand split — one 5-stat pass for narrow
     # levels, two 3-stat passes wider).  This routes AROUND the XLA
@@ -340,9 +358,9 @@ def histogram_leafbatch(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         precision = ("bf16" if compute_dtype == jnp.bfloat16 else "f32")
         telemetry.count("hist/pallas_" + precision)
         with telemetry.span("histogram") as sp:
-            return sp.fence(hist_pallas_float_leafbatch(
+            return sp.fence(_feat_take(hist_pallas_float_leafbatch(
                 bins, grad, hess, col_id, col_ok, num_cols, num_bins_max,
-                precision=precision, packing=packing))
+                precision=precision, packing=packing), feat_gather, 1))
     telemetry.count("hist/xla_einsum")
     with jax.named_scope("histogram"), telemetry.span("histogram") as sp:
         if _packing_active(packing):
@@ -357,11 +375,12 @@ def histogram_leafbatch(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                     jax.lax.slice_in_dim(bins, start, start + cnt, axis=0),
                     grad, hess, col_id, col_ok, num_cols, width,
                     chunk=eff_chunk, compute_dtype=compute_dtype))
-            return sp.fence(_assemble_classes(
-                parts, packing, num_bins_max, feat_axis=1, bin_axis=2))
-        return sp.fence(_leafbatch_einsum(
+            return sp.fence(_feat_take(_assemble_classes(
+                parts, packing, num_bins_max, feat_axis=1, bin_axis=2),
+                feat_gather, 1))
+        return sp.fence(_feat_take(_leafbatch_einsum(
             bins, grad, hess, col_id, col_ok, num_cols, num_bins_max,
-            chunk=chunk, compute_dtype=compute_dtype))
+            chunk=chunk, compute_dtype=compute_dtype), feat_gather, 1))
 
 
 def _leafbatch_einsum(bins, grad, hess, col_id, col_ok, num_cols: int,
@@ -436,7 +455,7 @@ def histogram_leafbatch_segsum(bins, grad, hess, col_id, col_ok,
                                num_cols: int, num_bins_max: int,
                                chunk: int = 0, compute_dtype=None,
                                axis_name=None, int_reduce=None, salt=0,
-                               packing=None):
+                               packing=None, feat_gather=None):
     """Scatter-add leaf-batched histogram — CPU-fast oracle with the same
     [C, F, B, 3] contract as histogram_leafbatch (scatter beats the dense
     one-hot matmul off-TPU; summation ORDER differs, so f32 sums match the
@@ -456,13 +475,13 @@ def histogram_leafbatch_segsum(bins, grad, hess, col_id, col_ok,
     vals = jnp.broadcast_to(vals[None], (F, N, 3)).reshape(-1, 3)
     hist = jax.ops.segment_sum(vals, ids.reshape(-1),
                                num_segments=(C + 1) * F * B)
-    return hist.reshape(C + 1, F, B, 3)[:C]
+    return _feat_take(hist.reshape(C + 1, F, B, 3)[:C], feat_gather, 1)
 
 
 def hist_quant_segsum(bins, grad, hess, col_id, col_ok, num_cols: int,
                       num_bins_max: int, chunk: int = 0, rng_bits=None,
                       compute_dtype=None, axis_name=None, int_reduce=None,
-                      salt=0, packing=None):
+                      salt=0, packing=None, feat_gather=None):
     """Scatter-add variant of the quantized-gradient histogram — exact
     int32 accumulation, so it is bit-identical to hist_pallas/hist_quant_xla
     (ops/hist_pallas.py) at any summation order; the CPU-fast oracle for
@@ -489,13 +508,13 @@ def hist_quant_segsum(bins, grad, hess, col_id, col_ok, num_cols: int,
         telemetry.record_collective("hist/int8_segsum_psum", "psum",
                                     axis_name, telemetry._tree_nbytes(hist))
         hist = jax.lax.psum(hist, axis_name)   # int-domain cross-shard sum
-    hist = hist.reshape(C + 1, F, B, 3)[:C].astype(jnp.float32)
-    return hist * scale
+    hist = _feat_take(hist.reshape(C + 1, F, B, 3)[:C], feat_gather, 1)
+    return hist.astype(jnp.float32) * scale
 
 
 def histogram_segsum(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                      mask: jax.Array, num_bins_max: int,
-                     packing=None) -> jax.Array:
+                     packing=None, feat_gather=None) -> jax.Array:
     """Scatter-add backend (CPU-friendly, used by tests as an oracle)."""
     if _packing_active(packing):
         bins = _unpack_bins(bins, packing)
@@ -507,13 +526,14 @@ def histogram_segsum(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     vals = jnp.stack([grad * maskf, hess * maskf, maskf], axis=1)  # [N, 3]
     vals = jnp.broadcast_to(vals[None], (F, N, 3)).reshape(-1, 3)
     hist = jax.ops.segment_sum(vals, ids, num_segments=F * B)
-    return hist.reshape(F, B, 3)
+    return _feat_take(hist.reshape(F, B, 3), feat_gather, 0)
 
 
 def build_histogram(bins, grad, hess, mask, num_bins_max, *,
                     backend: str = "matmul", chunk: int = 16384,
                     compute_dtype=jnp.float32, axis_name=None,
-                    int_reduce=None, salt=0, packing=None) -> jax.Array:
+                    int_reduce=None, salt=0, packing=None,
+                    feat_gather=None) -> jax.Array:
     """``int_reduce``: optional int-domain cross-shard reduction for the
     quantized path (feature axis 0) — the data-parallel reduce_scatter
     ownership schedule passes a psum_scatter here so the accumulators are
@@ -528,7 +548,7 @@ def build_histogram(bins, grad, hess, mask, num_bins_max, *,
                                   compute_dtype=compute_dtype,
                                   axis_name=axis_name,
                                   int_reduce=int_reduce, salt=salt,
-                                  packing=packing)
+                                  packing=packing, feat_gather=feat_gather)
         return out[0]
     if backend == "matmul":
         if _pallas_hist_ok(num_bins_max):
@@ -540,12 +560,13 @@ def build_histogram(bins, grad, hess, mask, num_bins_max, *,
             out = histogram_leafbatch(bins, grad, hess, cid, mask, 1,
                                       num_bins_max, chunk=chunk,
                                       compute_dtype=compute_dtype,
-                                      packing=packing)
+                                      packing=packing,
+                                      feat_gather=feat_gather)
             return out[0]
         return histogram_matmul(bins, grad, hess, mask, num_bins_max,
                                 chunk=chunk, compute_dtype=compute_dtype,
-                                packing=packing)
+                                packing=packing, feat_gather=feat_gather)
     if backend == "segsum":
         return histogram_segsum(bins, grad, hess, mask, num_bins_max,
-                                packing=packing)
+                                packing=packing, feat_gather=feat_gather)
     raise ValueError(f"unknown histogram backend {backend!r}")

@@ -61,11 +61,24 @@ def bag_mask_for_draw(base_key, draw_index: int, num_rows: int,
                      num_rows, bag_cnt)
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("top_cnt", "other_cnt", "amp"))
-def _goss_select(key, grad, hess, top_cnt: int, other_cnt: int,
-                 amp: float):
-    absg = jnp.sum(jnp.abs(grad.astype(jnp.float32)), axis=0)
+def goss_row_scores(grad):
+    """The GOSS row score: summed absolute gradient across classes —
+    single-homed so the per-iteration jit and the fused chunk programs
+    (serial scan body, DP shard closures) compute the identical f32
+    values row for row."""
+    return jnp.sum(jnp.abs(grad.astype(jnp.float32)), axis=0)
+
+
+def goss_mask_weights(key, absg, top_cnt: int, other_cnt: int,
+                      amp: float):
+    """The traced GOSS draw over row scores: top_cnt rows by score,
+    other_cnt uniform remainder rows, amplification weights.  The exact
+    formula ``_goss_select`` jits — factored out so the fused chunk
+    programs (ISSUE 12: serial scan body, DP shard_map with gathered
+    global scores, FP replicated rows) trace the identical selection and
+    a sampled iteration is bit-identical across dispatch paths given the
+    same key and row count.  Returns ``(mask [n] bool, w [n] f32)`` with
+    ``w`` = amp on the sampled remainder, 1 elsewhere."""
     n = absg.shape[0]
     # descending gradient-magnitude order (stable: ties resolve by row
     # index, deterministically)
@@ -77,6 +90,15 @@ def _goss_select(key, grad, hess, top_cnt: int, other_cnt: int,
     pick = rest[jnp.argsort(u)[:other_cnt]]
     mask = mask.at[pick].set(True)
     w = jnp.ones((n,), jnp.float32).at[pick].set(jnp.float32(amp))
+    return mask, w
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("top_cnt", "other_cnt", "amp"))
+def _goss_select(key, grad, hess, top_cnt: int, other_cnt: int,
+                 amp: float):
+    mask, w = goss_mask_weights(key, goss_row_scores(grad), top_cnt,
+                                other_cnt, amp)
     return grad * w, hess * w, mask
 
 

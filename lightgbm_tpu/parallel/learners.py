@@ -240,7 +240,8 @@ def dp_ownership_seams(F: int, num_shards: int, site_prefix: str = "dp_rs",
 
 def hybrid_ownership_seams(F: int, feature_shards: int, site_prefix: str,
                            loop: int = 1, phase: str = "grow",
-                           root_loop: int = 1, slice_hist: bool = False):
+                           root_loop: int = 1, slice_hist: bool = False,
+                           pack=None):
     """``dp_ownership_seams`` generalized to the 2-D ``(data, feature)``
     mesh (ISSUE 9): contiguous feature-block ownership lives on the
     FEATURE axis and the histogram reduction runs over the DATA axis,
@@ -254,6 +255,17 @@ def hybrid_ownership_seams(F: int, feature_shards: int, site_prefix: str,
     ``slice_hist=True`` (the compact pane keeps all F features): local
     histograms are full-F and the seam cuts the owned block out BEFORE
     the psum, so the wire still carries only the block.
+
+    ``pack`` (io/binning.BlockedPackSpec, masked closures only): the
+    block-local mixed-bin layout — the owned slice's histogram rows are
+    then in PACKED (bin-width-class) order, and the split finder gathers
+    them back to canonical block order before the search, so split
+    results, argmax tie-breaks and the packed-SplitInfo allreduce are
+    bit-identical to the uniform layout.  The psum seams ride unchanged:
+    the permutation never crosses the block boundary, so the reduced
+    payload is the same feature set either way.  The compact closures
+    (``slice_hist=True``) pass ``pack=None`` — their histograms assemble
+    canonically inside the histogram op (global blocked ranges).
 
     Returns a traced-context fn (fmask, nbins) ->
     (own_s, fmask_own, nbins_own, SeamSchedule)."""
@@ -309,6 +321,7 @@ def hybrid_ownership_seams(F: int, feature_shards: int, site_prefix: str,
                            lambda st: jax.lax.psum(st, DATA_AXIS),
                            kind="psum", loop=root_loop),
             root_hist_reduce=root_hist_reduce, own_slice=own_slice,
+            hist_feat_gather=_block_feat_gather(pack, own_s, rank, Fb),
             split_finder=ownership_finder(
                 own_s, FEATURE_AXIS,
                 site=site_prefix + "/splitinfo_allreduce", loop=loop,
@@ -317,9 +330,30 @@ def hybrid_ownership_seams(F: int, feature_shards: int, site_prefix: str,
     return seams
 
 
+def _block_feat_gather(pack, own_s, rank, Fb: int):
+    """The grower's ``hist_feat_gather`` seam for a block-locally PACKED
+    owned slice (io/binning.BlockedPackSpec): TRACED [Fb] indices mapping
+    canonical block position -> within-block storage position, handed to
+    every histogram build (ops/histogram feat_gather) so the kernels
+    restore canonical order IN THE INT DOMAIN (before dequantize/psum)
+    — the hist cache, int8-derived root stats, sibling subtraction and
+    split search are then all canonical, and the f32 graph downstream is
+    shape-identical to the uniform layout's, so packed-vs-uniform stays
+    bit-identical including argmax tie-breaks and XLA FMA-contraction
+    choices.  Derived from the shard's rank against the global
+    canonical->storage map, so the SPMD program is shard-uniform even
+    though each block's inner permutation differs.  None when ``pack``
+    is None (uniform layout).  Padding lanes clamp; they are masked out
+    of the search by fmask_own & ownok either way."""
+    if pack is None:
+        return None
+    c2p = jnp.asarray(pack.c2p, jnp.int32)
+    return jnp.clip(jnp.take(c2p, own_s) - rank * Fb, 0, Fb - 1)
+
+
 def voting_seams(F: int, feature_shards: int, top_k: int, int8: bool,
                  site_prefix: str, loop: int = 1, phase: str = "grow",
-                 root_loop: int = 1, lanes: int = 1):
+                 root_loop: int = 1, lanes: int = 1, pack=None):
     """Voting-parallel seams (ISSUE 9) — the reference NAMES this learner
     but Fatals on it (src/io/config.cpp:311-313); this realizes the
     PV-tree design on the 2-D mesh's data axis:
@@ -367,6 +401,16 @@ def voting_seams(F: int, feature_shards: int, top_k: int, int8: bool,
 
     def seams(fmask, nbins):
         idx, ownok, own_s = block_ids()
+        # block-local mixed-bin layout (the masked closures pre-slice
+        # ``bins`` in packed storage order): the histogram kernels gather
+        # the accumulators back to canonical block order in the int
+        # domain (_block_feat_gather), so the vote scoring, tie-breaks
+        # and exchanged payloads below match the uniform layout bit for
+        # bit
+        feat_gather = _block_feat_gather(
+            pack, own_s,
+            jax.lax.axis_index(FEATURE_AXIS) if pack is not None else 0,
+            Fb)
 
         def make_finder(tag, loop_est, lane_scale):
           # tag distinguishes the root sites: a telemetry site carries ONE
@@ -442,6 +486,7 @@ def voting_seams(F: int, feature_shards: int, top_k: int, int8: bool,
             stat_reduce=_c(site_prefix + "/root_stats",
                            lambda st: jax.lax.psum(st, DATA_AXIS),
                            kind="psum", loop=root_loop),
+            hist_feat_gather=feat_gather,
             split_finder=make_finder("", loop, lanes),
             # the ONE root search files its exchange on root_-tagged
             # sites at root_loop (the body finder traces inside the
@@ -642,7 +687,7 @@ class DataParallelLearner(_ParallelLearnerBase):
                       train_metric_fns=(), valid_metric_fns=(),
                       n_valid: int = 0, shard_layout=None,
                       needs_global_score: bool = False,
-                      health: bool = False):
+                      health: bool = False, goss=None):
         """Fused k-iteration training program under shard_map: the whole
         gradients → grow(psum'd histograms) → score-update scan runs sharded
         over the mesh, one dispatch per chunk (the data-parallel analog of
@@ -696,7 +741,7 @@ class DataParallelLearner(_ParallelLearnerBase):
         key = (obj_key, id(grad_fn), num_shards, num_class, lr, depthwise,
                tuple(sorted(kwargs.items())), has_bag, has_ff, n_true,
                shard_layout, needs_global_score, use_scatter, use_compact,
-               self._schedule(), use_pp,
+               goss, self._schedule(), use_pp,
                use_pp and partition_overlap_on(), jax.default_backend(),
                getattr(self.config, 'device_type', ''),
                num_features, bool(health), self._key_extra(),
@@ -712,30 +757,82 @@ class DataParallelLearner(_ParallelLearnerBase):
         # before anything inside the body is traced
         chunk_k = [1]
 
+        def _gather_compact(vec, site):
+            """all_gather row-aligned values over the data axis and
+            compact out the per-process padding — the ONE home of the
+            padded-global -> true-row rule (the in-program train metrics
+            AND the in-chunk GOSS row scores both ride it).
+            Single-process runs pad only at the tail (slice to n_true);
+            multi-process runs pad each process block, so the static
+            shard_layout ((start, len) per process) concatenates the
+            true row ranges in process order — matching the order the
+            global metric metadata was gathered in (gbdt.init).
+            Returns ``(compacted, padded_row_count)``."""
+            telemetry.record_collective(
+                site, "all_gather", DATA_AXIS,
+                telemetry._tree_nbytes(vec), loop=chunk_k[0],
+                phase="train_chunk")
+            full = jax.lax.all_gather(vec, DATA_AXIS, axis=-1, tiled=True)
+            if shard_layout is None:
+                return full[..., :n_true], full.shape[-1]
+            return jnp.concatenate(
+                [jax.lax.slice_in_dim(full, st, st + ln, axis=-1)
+                 for st, ln in shard_layout], axis=-1), full.shape[-1]
+
         def gathered(f):
-            # train metrics need the GLOBAL score: gather the row shards
-            # and compact out the padding before the metric formulation.
-            # Single-process runs pad only at the tail (slice to n_true);
-            # multi-process runs pad each process block, so the static
-            # shard_layout ((start, len) per process) concatenates the
-            # true row ranges in process order — matching the order the
-            # global metric metadata was gathered in (gbdt.init)
+            # train metrics need the GLOBAL score
             def g(p, s):
-                telemetry.record_collective(
-                    "dp/metric_score_allgather", "all_gather", DATA_AXIS,
-                    telemetry._tree_nbytes(s), loop=chunk_k[0],
-                    phase="train_chunk")
-                full = jax.lax.all_gather(s, DATA_AXIS, axis=-1, tiled=True)
-                if shard_layout is None:
-                    comp = full[..., :n_true]
-                else:
-                    comp = jnp.concatenate(
-                        [jax.lax.slice_in_dim(full, st, st + ln, axis=-1)
-                         for st, ln in shard_layout], axis=-1)
+                comp, _ = _gather_compact(s, "dp/metric_score_allgather")
                 return f(p, comp)
             return g
 
         train_fns = tuple(gathered(f) for f in train_metric_fns)
+
+        goss_fn = None
+        if goss is not None:
+            # in-chunk GOSS on the data-sharded layout (ISSUE 12): the
+            # per-row |grad| scores are all_gathered over the data axis,
+            # the draw runs on the COMPACTED true-row layout (exactly
+            # the serial/per-iteration selection — same key, same row
+            # count, bit-identical), and each shard slices its own
+            # rows' mask/weights back out.  Selection is a pure function
+            # of the globally-identical gradients, so every shard — and
+            # every process in a multi-process job — computes the
+            # identical selection.
+            g_seed, g_top, g_other, g_amp = goss
+            from ..ops import sampling as _sampling
+
+            def goss_fn(it, grad, hess):
+                absg = _sampling.goss_row_scores(grad)       # [n_local]
+                absg_true, n_pad = _gather_compact(
+                    absg, "dp/goss_score_allgather")
+
+                def expand(vec_true, fill):
+                    # compacted true-row vector -> padded global layout
+                    if shard_layout is None:
+                        return jnp.pad(vec_true, (0, n_pad - n_true),
+                                       constant_values=fill)
+                    pm = np.full(n_pad, n_true, np.int32)
+                    off = 0
+                    for st, ln in shard_layout:
+                        pm[st:st + ln] = off + np.arange(ln)
+                        off += ln
+                    ext = jnp.concatenate(
+                        [vec_true,
+                         jnp.full((1,), fill, vec_true.dtype)])
+                    return jnp.take(ext, jnp.asarray(pm))
+
+                key = jax.random.fold_in(jax.random.PRNGKey(g_seed), it)
+                mask_t, w_t = _sampling.goss_mask_weights(
+                    key, absg_true, g_top, g_other, g_amp)
+                mask_pad = expand(mask_t, False)
+                w_pad = expand(w_t, 1.0)
+                rows = grad.shape[-1]
+                i = jax.lax.axis_index(DATA_AXIS)
+                msl = jax.lax.dynamic_slice_in_dim(mask_pad, i * rows,
+                                                   rows)
+                wsl = jax.lax.dynamic_slice_in_dim(w_pad, i * rows, rows)
+                return grad * wsl, hess * wsl, msl
 
         if needs_global_score:
             # global-score objectives (lambdarank): pairwise lambdas need
@@ -766,7 +863,7 @@ class DataParallelLearner(_ParallelLearnerBase):
 
         def shard_chunk(score, bins, num_bins, valid_rows, row_masks,
                         feat_masks, obj_params, train_mparams, valid_bins,
-                        valid_scores, valid_mparams):
+                        valid_scores, valid_mparams, goss_iters=None):
             from ..models.gbdt import make_chunk_body
             chunk_k[0] = int(row_masks.shape[0])
             grow_fn = self._chunk_grow_fn(kwargs, num_features, num_shards,
@@ -781,10 +878,12 @@ class DataParallelLearner(_ParallelLearnerBase):
                 max_nodes=max_nodes, valid_bins=valid_bins,
                 valid_mparams=valid_mparams,
                 train_metric_fns=train_fns, train_mparams=train_mparams,
-                valid_metric_fns=valid_metric_fns, health_fn=health_fn)
+                valid_metric_fns=valid_metric_fns, health_fn=health_fn,
+                goss_fn=goss_fn)
+            xs = ((row_masks, feat_masks) if goss_fn is None
+                  else (row_masks, feat_masks, goss_iters))
             (score, vscores), (stacked, mvals, hvals) = jax.lax.scan(
-                body, (score, tuple(valid_scores)),
-                (row_masks, feat_masks))
+                body, (score, tuple(valid_scores)), xs)
             return score, vscores, stacked, mvals, hvals
 
         def param_spec(leaf):
@@ -795,16 +894,19 @@ class DataParallelLearner(_ParallelLearnerBase):
             return P()
 
         pspecs = jax.tree.map(param_spec, obj_params)
+        in_specs = (P(None, DATA_AXIS), P(None, DATA_AXIS), P(),
+                    P(DATA_AXIS),
+                    P(None, None, DATA_AXIS) if has_bag else P(),
+                    P(), pspecs,
+                    # metric params / valid sets are replicated (a single
+                    # P() broadcasts over the whole subtree)
+                    P(), P(), P(), P())
+        if goss is not None:
+            in_specs = in_specs + (P(),)     # goss_iters, replicated
         from .. import costmodel
         prog = costmodel.instrument("chunk/dp", jax.jit(shard_map(
             shard_chunk, mesh=mesh,
-            in_specs=(P(None, DATA_AXIS), P(None, DATA_AXIS), P(),
-                      P(DATA_AXIS),
-                      P(None, None, DATA_AXIS) if has_bag else P(),
-                      P(), pspecs,
-                      # metric params / valid sets are replicated (a single
-                      # P() broadcasts over the whole subtree)
-                      P(), P(), P(), P()),
+            in_specs=in_specs,
             out_specs=(P(None, DATA_AXIS),
                        tuple(P() for _ in range(n_valid)),
                        _tree_out_specs(None), P(), P()))),
@@ -973,7 +1075,11 @@ class DataParallelLearner(_ParallelLearnerBase):
         total = max(L - 1, 1)
         per = -(-total // max(segments, 1))
         cache = getattr(self, "_seg_progs", None)
-        if cache is None or cache[0] != (F, num_shards, per):
+        # the resolved mixed-bin layout rides the key like the jit_key in
+        # __call__ (graftlint R2: the traced per-class pass structure is
+        # baked into the segment programs)
+        seg_key = (F, num_shards, per, getattr(gbdt, "_pack_spec", None))
+        if cache is None or cache[0] != seg_key:
             grow_fn = self._grow_fn(kwargs, F, num_shards)
             in_specs = (P(None, DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
                         P(DATA_AXIS), P(), P())
@@ -997,7 +1103,7 @@ class DataParallelLearner(_ParallelLearnerBase):
                               in_specs=in_specs + (sspec,),
                               out_specs=sspec),
                     donate_argnums=(6,))
-            cache = ((F, num_shards, per), init_p, seg_ps)
+            cache = (seg_key, init_p, seg_ps)
             self._seg_progs = cache
         _, init_p, seg_ps = cache
         args = (bins, grad, hess, row_mask, feature_mask,
@@ -1057,9 +1163,15 @@ class DataParallelLearner(_ParallelLearnerBase):
         # the old kernel routing
         from ..ops.compact import pallas_partition_ok, partition_overlap_on
         use_pp = use_compact and pallas_partition_ok(F)
+        # the resolved mixed-bin layout spec is a cache-key bit exactly
+        # like the kernel-routing flags (graftlint R2): the traced
+        # program bakes the per-class pass structure AND the canonical
+        # reorder gathers in, so a booster with a different ``_pack_spec``
+        # must not reuse this learner's jitted program
         jit_key = (use_pp, use_pp and partition_overlap_on(),
                    jax.default_backend(),
                    getattr(self.config, 'device_type', ''),
+                   getattr(gbdt, "_pack_spec", None),
                    self._key_extra())
         if self._jitted is None or getattr(self, "_jit_key", None) != jit_key:
             self._jit_key = jit_key
@@ -1105,12 +1217,35 @@ class HybridLearner(DataParallelLearner):
     axis — so every growth policy x chunk path works unchanged."""
 
     route_name = "hybrid"
-    # feature-block ownership slices the bin matrix by canonical feature
-    # blocks; the mixed-bin class-contiguous storage layout cannot serve
-    # them (same restriction as the feature-parallel learner) — gbdt.init
-    # keeps the uniform layout when this is set
-    needs_uniform_layout = True
+    # mixed-bin packing composes with feature-block ownership via the
+    # BLOCK-LOCAL layout (ISSUE 12, io/binning.BlockedPackSpec): the
+    # bin-width-class permutation never crosses an ownership block
+    # boundary, so the owned-block psum and packed-SplitInfo allreduce
+    # ride unchanged.  gbdt.init plans with ``pack_layout(F)``.
+    feature_block_packing = True
     voting = False
+
+    def pack_layout(self, num_features: int):
+        """``(block, feature_shards)`` the block-local mixed-bin plan
+        must respect — ``block`` == _owned_block's Fb for this mesh; the
+        shard count lets the plan refuse meshes where a shard owns only
+        ownership padding."""
+        fs = self._feature_shards()
+        return -(-num_features // fs), fs
+
+    @staticmethod
+    def _split_pack(kwargs):
+        """(grow-call kwargs, pack) for a masked shard closure: under the
+        block-local layout the owned slice's histogram passes use the
+        shard-uniform ``block_view`` while split application translates
+        through the GLOBAL canonical->storage map (partition_packing)."""
+        pk = kwargs.get("packing")
+        if pk is None or not hasattr(pk, "block_view"):
+            return kwargs, None
+        kw = dict(kwargs)
+        kw["packing"] = pk.block_view
+        kw["partition_packing"] = pk
+        return kw, pk
 
     def _mesh(self):
         return get_mesh2d(self.config.network_config.num_machines,
@@ -1143,9 +1278,10 @@ class HybridLearner(DataParallelLearner):
         fs = self._feature_shards()
         loop = loop_scale * (1 if policy == "depthwise"
                              else kwargs["num_leaves"] - 1)
+        kw, pack = self._split_pack(kwargs)
         seams = hybrid_ownership_seams(
             F, fs, site_prefix="hybrid/%s" % policy, loop=loop,
-            phase=phase, root_loop=loop_scale)
+            phase=phase, root_loop=loop_scale, pack=pack)
 
         def shard_grow(bins_s, grad_s, hess_s, mask_s, fmask, nbins,
                        **extra):
@@ -1154,7 +1290,7 @@ class HybridLearner(DataParallelLearner):
             return grow_tree_unified(
                 bins_own, grad_s, hess_s, mask_s, fmask_own, nbins_own,
                 policy=policy, schedule=schedule, partition_bins=bins_s,
-                **kwargs, **extra)
+                **kw, **extra)
         return shard_grow
 
     def _compact_grow_fn(self, kwargs, F: int, num_shards: int,
@@ -1215,20 +1351,22 @@ class VotingLearner(HybridLearner):
     supports_leafwise_segments = False
 
     def _voting_seams(self, kwargs, F: int, site: str, loop: int,
-                      phase: str, root_loop: int, lanes: int = 1):
+                      phase: str, root_loop: int, lanes: int = 1,
+                      pack=None):
         int8 = str(kwargs.get("compute_dtype", "")).startswith("int8")
         return voting_seams(F, self._feature_shards(),
                             int(getattr(self.tree_config, "top_k", 20)),
                             int8, site_prefix=site, loop=loop,
                             phase=phase, root_loop=root_loop,
-                            lanes=lanes)
+                            lanes=lanes, pack=pack)
 
     def _psum_grow_fn(self, kwargs, F: int, policy: str,
                       phase: str = "grow", loop_scale: int = 1):
         loop = loop_scale * (1 if policy == "depthwise"
                              else kwargs["num_leaves"] - 1)
+        kw, pack = self._split_pack(kwargs)
         seams = self._voting_seams(kwargs, F, "voting/%s" % policy, loop,
-                                   phase, loop_scale)
+                                   phase, loop_scale, pack=pack)
         _, _, block_ids = _owned_block(F, self._feature_shards(),
                                        FEATURE_AXIS)
 
@@ -1239,7 +1377,9 @@ class VotingLearner(HybridLearner):
             # cache never touch un-owned features — the local caches and
             # the voted exchange inside the split finder both live on the
             # block — while splits apply on the full-F local rows via
-            # ``partition_bins``
+            # ``partition_bins``.  Block-local packing rides the same
+            # slice: the permutation never crosses the block boundary,
+            # and the finder restores canonical order (voting_seams pack)
             schedule = seams(fmask, nbins)
             _, ownok, own_s = block_ids()
             bins_own = jnp.take(bins_s, own_s, axis=0)
@@ -1247,7 +1387,7 @@ class VotingLearner(HybridLearner):
                 bins_own, grad_s, hess_s, mask_s,
                 fmask[own_s] & ownok, jnp.take(nbins, own_s),
                 policy=policy, schedule=schedule, partition_bins=bins_s,
-                **kwargs, **extra)
+                **kw, **extra)
         return shard_grow
 
     def _compact_grow_fn(self, kwargs, F: int, num_shards: int,
@@ -1367,7 +1507,7 @@ class FeatureParallelLearner(_ParallelLearnerBase):
     def chunk_program(self, gbdt, obj_key, grad_fn, obj_params,
                       has_bag: bool, has_ff: bool,
                       train_metric_fns=(), valid_metric_fns=(),
-                      n_valid: int = 0, health: bool = False):
+                      n_valid: int = 0, health: bool = False, goss=None):
         """Fused k-iteration feature-parallel chunk (same contract as the
         data-parallel chunk_program / serial chunk program).  Rows are
         replicated, so metric evaluation needs no gathering — and neither
@@ -1393,7 +1533,7 @@ class FeatureParallelLearner(_ParallelLearnerBase):
         # flips) bakes the backend into the program
         key = (obj_key, id(grad_fn), num_shards, num_class, lr,
                self._depthwise, tuple(sorted(kwargs.items())), has_bag,
-               has_ff, bool(health), jax.default_backend(),
+               has_ff, bool(health), goss, jax.default_backend(),
                getattr(self.config, 'device_type', ''),
                tuple(id(f) for f in train_metric_fns),
                tuple(tuple(id(f) for f in fns) for fns in valid_metric_fns))
@@ -1402,10 +1542,15 @@ class FeatureParallelLearner(_ParallelLearnerBase):
             return prog, num_shards
 
         lrf = jnp.float32(lr)
+        # rows are replicated under feature ownership, so in-chunk GOSS
+        # is the serial full-row draw (every shard computes the identical
+        # selection from the identical gradients)
+        from ..models.gbdt import make_goss_fn
+        goss_fn = make_goss_fn(goss) if goss is not None else None
 
         def shard_chunk(score, bins, num_bins, own, ownmask, row_masks,
                         feat_masks, obj_params, train_mparams, valid_bins,
-                        valid_scores, valid_mparams):
+                        valid_scores, valid_mparams, goss_iters=None):
             from ..models.gbdt import make_chunk_body
             body = make_chunk_body(
                 grad_fn=grad_fn, obj_params=obj_params, num_class=num_class,
@@ -1418,16 +1563,18 @@ class FeatureParallelLearner(_ParallelLearnerBase):
                 valid_bins=valid_bins, valid_mparams=valid_mparams,
                 train_metric_fns=train_metric_fns,
                 train_mparams=train_mparams,
-                valid_metric_fns=valid_metric_fns, health_fn=health_fn)
+                valid_metric_fns=valid_metric_fns, health_fn=health_fn,
+                goss_fn=goss_fn)
+            xs = ((row_masks, feat_masks) if goss_fn is None
+                  else (row_masks, feat_masks, goss_iters))
             (score, vscores), (stacked, mvals, hvals) = jax.lax.scan(
-                body, (score, tuple(valid_scores)),
-                (row_masks, feat_masks))
+                body, (score, tuple(valid_scores)), xs)
             return score, vscores, stacked, mvals, hvals
 
         from .. import costmodel
         prog = costmodel.instrument("chunk/fp", jax.jit(shard_map(
             shard_chunk, mesh=mesh,
-            in_specs=(P(),) * 12,
+            in_specs=(P(),) * (13 if goss is not None else 12),
             out_specs=(P(), tuple(P() for _ in range(n_valid)),
                        _tree_out_specs(None), P(), P()))),
             phase="train_chunk")

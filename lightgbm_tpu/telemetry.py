@@ -989,7 +989,12 @@ def span(name: str):
 # gbdt.init records the layout decision once per booster via
 # ``count_route("hist_layout", "hist/mixedbin_on"|"hist/mixedbin_off")``
 # — the runtime answer to "did this run actually pack, and on which
-# kernels".  Pipelined boosting deliberately adds NO counters: it changes
+# kernels".  The BLOCK-LOCAL layout (ISSUE 12, hybrid/voting ownership
+# meshes) additionally files ``hist/mixedbin_blocked`` once per booster,
+# and in-chunk GOSS bumps ``goss/iterations`` by the chunk length at
+# dispatch (the same counter the per-iteration path bumps per draw) —
+# the fused DP selection's score allgather records on the
+# ``dp/goss_score_allgather`` wire-metrics site.  Pipelined boosting deliberately adds NO counters: it changes
 # host wait order only, and the phase spans (model_readback migrating off
 # the critical path) are the observable.
 #

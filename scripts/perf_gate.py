@@ -72,6 +72,10 @@ RATE_KEYS: Tuple[Tuple[str, str], ...] = (
     # per-class histogram schedule even if the headline's auto
     # resolution ever changes
     ("mixedbin_iters_per_sec", "mixedbin_spread"),
+    # the COMPOSED configuration (ISSUE 12): block-local mixed-bin
+    # packing on the 2-D hybrid mesh, pinned explicitly ON — the lane
+    # that proves the speed tiers multiply instead of exclude
+    ("mixedbin_hybrid_iters_per_sec", "mixedbin_hybrid_spread"),
     # serving lanes (ISSUE 7, bench.py --bench-predict): predictions/sec
     # off the compiled serving engine at the gated bucket shapes — the
     # 64k throughput bucket (f32 and int8 ensembles) and the 1k
@@ -231,6 +235,7 @@ def _check_group(metric: str, entries: List[dict], floor: float,
                       "shape (the compiled-program ladder is no longer "
                       "closed)",
         })
+    _check_mixedbin_resolution(metric, entries[-1], findings)
     if len(entries) < 2:
         return
     latest_round = entries[-1]["round"]
@@ -257,6 +262,40 @@ def _check_group(metric: str, entries: List[dict], floor: float,
                 "latest": latest, "baseline": round(baseline, 6),
                 "drop": round(1.0 - latest / baseline, 4),
                 "allowed_drop": round(sigma_mult * sigma, 4),
+            })
+
+
+def _check_mixedbin_resolution(metric: str, latest: dict,
+                               findings: List[dict]) -> None:
+    """ISSUE 12 absolute finding, no trajectory needed: a recorded
+    hybrid/voting round whose config requested ``mixed_bin`` auto/true
+    on a mixed-cardinality table but whose booster resolved the UNIFORM
+    layout — the silent fallback the pre-ISSUE-12 ``needs_uniform_layout``
+    gate used to take — must not pass the gate unnoticed.  Reads the
+    bench record's resolution keys (``tree_learner`` /
+    ``mixed_bin_requested`` / ``mixedbin_expected`` / ``mixed_bin_on``,
+    both bare for a headline parallel run and under the
+    ``mixedbin_hybrid_`` prefix the composed satellite lane copies).
+    ``mixedbin_expected`` guards ``auto``: a genuinely single-class
+    table resolving off is a correct resolution, not a regression."""
+    rec = latest["rec"]
+    for prefix in ("", "mixedbin_hybrid_"):
+        learner = rec.get(prefix + "tree_learner")
+        requested = rec.get(prefix + "mixed_bin_requested")
+        resolved = rec.get(prefix + "mixed_bin_on")
+        expected = rec.get(prefix + "mixedbin_expected")
+        if learner not in ("hybrid", "voting") or resolved is not False:
+            continue
+        if requested == "true" or (requested == "auto" and expected):
+            findings.append({
+                "metric": metric,
+                "key": (prefix or "headline_") + "mixed_bin_resolution",
+                "latest_round": latest["round"],
+                "latest": False, "baseline": True,
+                "detail": "%s round requested mixed_bin=%s on a "
+                          "mixed-cardinality table but resolved the "
+                          "uniform layout (block-local packing silently "
+                          "fell back)" % (learner, requested),
             })
 
 

@@ -1,0 +1,153 @@
+"""In-chunk GOSS (ISSUE 12): goss=true no longer excludes the fused
+chunk path.
+
+The selection — top_rate rows by |grad| + an amplified other_rate random
+remainder — is traced INTO the chunk scan body (models/gbdt.make_goss_fn
+for the serial/FP full-row layouts; the data-parallel variant in
+parallel/learners.chunk_program all_gathers the per-row scores over the
+data axis, draws on the COMPACTED true-row layout and slices each
+shard's mask/weights back out).  The key stream is
+``fold_in(PRNGKey(bagging_seed), iteration)`` — the per-iteration path's
+— so fused == per-iteration selection is bit-identical.  Pinned here:
+
+- chunk_supported no longer returns False for goss=true;
+- fused-chunk == per-iteration model equivalence (f32 and int8);
+- GOSS under single-process DP == serial GOSS (the acceptance row);
+- GOSS iterations dispatch through the fused chunk program — the
+  costmodel program inventory shows no per-iteration grow programs;
+- the per-iteration multi-process guard stays a precise fatal.
+"""
+import numpy as np
+import pytest
+
+from lightgbm_tpu import costmodel, telemetry
+from lightgbm_tpu.config import OverallConfig
+from lightgbm_tpu.io.dataset import Dataset
+from lightgbm_tpu.models.gbdt import GBDT
+from lightgbm_tpu.objectives import create_objective
+from lightgbm_tpu.parallel.learners import create_parallel_learner
+from lightgbm_tpu.utils.log import LightGBMError
+
+
+@pytest.fixture(scope="module")
+def goss_ds():
+    rng = np.random.RandomState(7)
+    n = 3000
+    x = rng.randn(n, 10)
+    y = ((x[:, 0] - 0.5 * x[:, 1]
+          + 0.3 * rng.randn(n)) > 0).astype(np.float32)
+    return Dataset.from_arrays(x, y, max_bin=63)
+
+
+def _mk(ds, tl="serial", extra=None):
+    p = {"objective": "binary", "num_leaves": "15", "min_data_in_leaf": "20",
+         "min_sum_hessian_in_leaf": "1.0", "learning_rate": "0.1",
+         "goss": "true", "top_rate": "0.2", "other_rate": "0.2",
+         "grow_policy": "depthwise", "tree_learner": tl}
+    p.update(extra or {})
+    cfg = OverallConfig()
+    cfg.set(p, require_data=False)
+    b = GBDT()
+    learner = None if tl == "serial" else create_parallel_learner(cfg)
+    b.init(cfg.boosting_config, ds,
+           create_objective(cfg.objective_type, cfg.objective_config),
+           learner=learner)
+    return b
+
+
+def _assert_models_equal(a, b, tag):
+    assert len(a.models) == len(b.models), tag
+    for k, (t1, t2) in enumerate(zip(a.models, b.models)):
+        np.testing.assert_array_equal(t1.split_feature, t2.split_feature,
+                                      err_msg=f"{tag} tree {k}")
+        np.testing.assert_array_equal(t1.threshold_bin, t2.threshold_bin,
+                                      err_msg=f"{tag} tree {k}")
+        np.testing.assert_array_equal(np.asarray(t1.leaf_value),
+                                      np.asarray(t2.leaf_value),
+                                      err_msg=f"{tag} tree {k}")
+    np.testing.assert_array_equal(np.asarray(a.score), np.asarray(b.score),
+                                  err_msg=tag)
+
+
+def test_goss_no_longer_excludes_chunking(goss_ds):
+    b = _mk(goss_ds)
+    assert b.chunk_supported(False)
+    assert b.chunkable_for(False)
+
+
+@pytest.mark.parametrize("hd", ["float32", "int8"])
+def test_goss_fused_chunk_equals_per_iteration(goss_ds, hd):
+    b1 = _mk(goss_ds, extra={"hist_dtype": hd})
+    b2 = _mk(goss_ds, extra={"hist_dtype": hd})
+    for _ in range(6):
+        b1.train_one_iter(is_eval=False)
+    b2.train_chunk(6)
+    b2.flush_pipeline()
+    _assert_models_equal(b1, b2, "goss chunk == per-iteration %s" % hd)
+
+
+def test_goss_dp_chunk_equals_serial(goss_ds):
+    # the acceptance row: GOSS under single-process DP == serial GOSS —
+    # the gathered-score selection reproduces the serial draw exactly,
+    # and the int8 histogram chain keeps the result bit-identical
+    bs = _mk(goss_ds, extra={"hist_dtype": "int8"})
+    bs.train_chunk(6)
+    bs.flush_pipeline()
+    bd = _mk(goss_ds, "data", {"num_machines": "4", "hist_dtype": "int8"})
+    bd.train_chunk(6)
+    bd.flush_pipeline()
+    _assert_models_equal(bs, bd, "goss DP chunk == serial chunk (int8)")
+
+
+def test_goss_dp_per_iteration_equals_serial(goss_ds):
+    bs = _mk(goss_ds, extra={"hist_dtype": "int8",
+                             "grow_policy": "leafwise"})
+    bd = _mk(goss_ds, "data", {"num_machines": "4", "hist_dtype": "int8",
+                               "grow_policy": "leafwise"})
+    for _ in range(3):
+        bs.train_one_iter(is_eval=False)
+        bd.train_one_iter(is_eval=False)
+    _assert_models_equal(bs, bd, "goss DP per-iter == serial per-iter")
+
+
+def test_goss_hybrid_chunk_equals_serial(goss_ds):
+    # the 2-D learners inherit the DP chunk program — GOSS composes with
+    # the ownership mesh
+    bs = _mk(goss_ds, extra={"hist_dtype": "int8"})
+    bs.train_chunk(4)
+    bs.flush_pipeline()
+    bh = _mk(goss_ds, "hybrid", {"num_machines": "4",
+                                 "feature_shards": "2",
+                                 "hist_dtype": "int8"})
+    bh.train_chunk(4)
+    bh.flush_pipeline()
+    _assert_models_equal(bs, bh, "goss hybrid chunk == serial chunk")
+
+
+def test_goss_dispatches_through_chunk_program(goss_ds):
+    # the acceptance pin: with goss=true, run_training routes through
+    # the fused chunk program — no per-iteration grow programs appear in
+    # the costmodel inventory
+    telemetry.enable()
+    telemetry.reset()
+    try:
+        b = _mk(goss_ds)
+        b.run_training(8, is_eval=False)
+        grow_progs = costmodel.phase_program_records("grow")
+        chunk_progs = costmodel.phase_program_records("train_chunk")
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+    assert len(chunk_progs) >= 1
+    assert len(grow_progs) == 0, [r["name"] for r in grow_progs]
+    assert len(b.models) == 8
+
+
+def test_goss_per_iteration_multiprocess_guard(goss_ds):
+    # the precise fatal: per-iteration multi-process GOSS is the one
+    # still-unsupported case (the chunk path serves multi-process)
+    b = _mk(goss_ds)
+    b._host_inputs = True
+    with pytest.raises(LightGBMError, match="per-iteration multi-process"):
+        b._goss_masks(np.zeros((1, 4), np.float32),
+                      np.zeros((1, 4), np.float32))

@@ -168,6 +168,42 @@ def test_r2_fires_on_laundered_device_type_read():
     assert f.rule == "R2" and f.site == "device_type"
 
 
+# the booster's resolved mixed-bin layout spec is a cache-key bit like
+# the kernel-routing flags (ISSUE 12): the traced program bakes the
+# per-class histogram pass structure (and, block-locally, the canonical
+# reorder gathers) in, so a cached program built while reading
+# ``_pack_spec`` must thread the spec (or a digest) into its key
+R2_PACK_BAD = """
+_MY_PROGRAMS = {}
+
+def get_program(self, gbdt, n):
+    packing = getattr(gbdt, "_pack_spec", None)
+    key = (n,)
+    _MY_PROGRAMS[key] = build(n, packing)
+    return _MY_PROGRAMS[key]
+"""
+
+R2_PACK_OK = """
+_MY_PROGRAMS = {}
+
+def get_program(self, gbdt, n):
+    packing = getattr(gbdt, "_pack_spec", None)
+    key = (n, packing)
+    _MY_PROGRAMS[key] = build(n, packing)
+    return _MY_PROGRAMS[key]
+"""
+
+
+def test_r2_fires_on_unkeyed_pack_spec_read():
+    (f,) = _lint(R2_PACK_BAD)
+    assert f.rule == "R2" and f.site == "_pack_spec"
+    assert f.symbol == "get_program"
+
+
+def test_r2_clean_when_pack_spec_rides_the_key():
+    assert _lint(R2_PACK_OK) == []
+
+
 # ======================================================= R3: span fences
 
 R3_BAD = """

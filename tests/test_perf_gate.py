@@ -109,6 +109,54 @@ def test_serving_recompiles_flagged_absolutely(tmp_path):
                for f in report["findings"])
 
 
+def test_mixedbin_resolution_flagged_absolutely(tmp_path):
+    """ISSUE 12: a hybrid/voting round that requested mixed_bin
+    auto/true on a mixed table but resolved the uniform layout is an
+    absolute finding — no trajectory needed (the silent
+    needs_uniform_layout fallback class)."""
+    bad = _write_round(tmp_path, 1, 2.0, extra={
+        "tree_learner": "hybrid", "mixed_bin_requested": "auto",
+        "mixedbin_expected": True, "mixed_bin_on": False})
+    report = perf_gate.check_files([bad])
+    assert any(f["key"] == "headline_mixed_bin_resolution"
+               for f in report["findings"])
+    # the satellite-lane prefix is checked too
+    bad2 = _write_round(tmp_path, 2, 2.0, extra={
+        "mixedbin_hybrid_tree_learner": "hybrid",
+        "mixedbin_hybrid_mixed_bin_requested": "true",
+        "mixedbin_hybrid_mixed_bin_on": False})
+    report = perf_gate.check_files([bad2])
+    assert any(f["key"] == "mixedbin_hybrid_mixed_bin_resolution"
+               for f in report["findings"])
+    # legit resolutions pass: packed ON; auto on a single-class table;
+    # a serial round carrying no learner keys
+    for extra in (
+            {"tree_learner": "hybrid", "mixed_bin_requested": "auto",
+             "mixedbin_expected": True, "mixed_bin_on": True},
+            {"tree_learner": "voting", "mixed_bin_requested": "auto",
+             "mixedbin_expected": False, "mixed_bin_on": False},
+            {"tree_learner": "serial", "mixed_bin_requested": "true",
+             "mixedbin_expected": True, "mixed_bin_on": False}):
+        ok = _write_round(tmp_path, 3, 2.0, extra=extra)
+        assert not perf_gate.check_files([ok])["findings"], extra
+
+
+def test_mixedbin_hybrid_lane_gated(tmp_path):
+    """The composed packing-on-the-2-D-mesh lane rides RATE_KEYS: a
+    3-sigma drop in mixedbin_hybrid_iters_per_sec is flagged."""
+    paths = _history(
+        tmp_path, [1.0, 1.0, 1.0, 1.0],
+        extra={"mixedbin_hybrid_iters_per_sec": 3.0,
+               "mixedbin_hybrid_spread": 0.02})
+    paths.append(_write_round(
+        tmp_path, 5, 1.0,
+        extra={"mixedbin_hybrid_iters_per_sec": 2.0,
+               "mixedbin_hybrid_spread": 0.02}))
+    report = perf_gate.check_files(paths)
+    assert any(f["key"] == "mixedbin_hybrid_iters_per_sec"
+               for f in report["findings"])
+
+
 def test_metric_groups_are_not_cross_compared(tmp_path):
     """A 1M round followed by 11M rounds (the real r01->r02 shape): the
     scale change must not read as an 80% regression."""
