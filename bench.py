@@ -273,6 +273,225 @@ def bench_predict(args) -> int:
     return 0
 
 
+# keys the headline bench copies out of the --bench-serve subprocess
+# (perf_gate gates serve_rows_per_sec on the rate trajectory and
+# serve_p99_us on a must-not-grow lane; serve_recompiles, serve_dropped
+# and serve_misscored are ABSOLUTE findings — any nonzero fails the
+# gate with no trajectory needed)
+SERVE_COPY_KEYS = (
+    "serve_rows_per_sec", "serve_spread", "serve_p50_us", "serve_p99_us",
+    "serve_offered_rows_per_sec", "serve_requests", "serve_linger_us",
+    "serve_recompiles", "serve_dropped", "serve_misscored",
+    "serve_swap_drain_ms", "serve_coalesced_batches",
+    "serve_mean_batch_rows", "serve_shards_used",
+)
+
+
+def bench_serve(args) -> int:
+    """Elastic-serving lane (ISSUE 13): p99 latency + rows/sec under a
+    CONCURRENT OPEN-LOOP load generator, plus a mid-load hot swap.
+
+    Unlike bench_predict (throughput on pre-formed batches), this lane
+    prices the full serving path a latency SLO sees: requests arrive on
+    a fixed open-loop schedule (arrivals never wait for completions, so
+    queueing delay is measured, not hidden), the ServingFront coalesces
+    them onto the bucket ladder under the linger deadline, and
+    per-request latency is submit → future completion.  A second phase
+    swaps to a DIFFERENT engine mid-load (drain-and-flip, double-
+    buffered warmup) and counts dropped and misscored requests — both
+    must be zero, and perf_gate flags any nonzero as an absolute
+    finding, like serve_recompiles."""
+    import jax  # noqa: F401  (device init before timing)
+    from lightgbm_tpu import costmodel, telemetry
+    from lightgbm_tpu.config import OverallConfig
+    from lightgbm_tpu.io.dataset import Dataset
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.objectives import create_objective
+    from lightgbm_tpu.serving import ServingEngine, ServingFront
+    from lightgbm_tpu.utils import log
+
+    log.set_stream(sys.stderr)
+    log.set_level(log.WARNING)
+    telemetry.enable(fence=True)
+    telemetry.reset()
+
+    train_rows = min(args.rows, 1_000_000)
+    narrow = (args.narrow_features if args.narrow_features >= 0
+              else (args.features * 6) // 7)
+    x, y = make_data(train_rows, args.features, narrow_features=narrow)
+    ds = Dataset.from_arrays(x, y, max_bin=args.max_bin)
+    cfg = OverallConfig()
+    cfg.set({
+        "objective": "binary", "num_leaves": str(args.leaves),
+        "min_data_in_leaf": "100", "min_sum_hessian_in_leaf": "10.0",
+        "learning_rate": "0.1", "grow_policy": "depthwise",
+        "hist_dtype": args.hist_dtype,
+        "num_iterations": str(args.iters),
+    }, require_data=False)
+    booster = GBDT()
+    booster.init(cfg.boosting_config, ds,
+                 create_objective(cfg.objective_type, cfg.objective_config))
+    booster.train_chunk(args.iters)
+    booster.flush_pipeline()
+    T = len(booster.models)
+
+    shards = max(int(args.serve_shards), 0)
+    linger_us = max(int(args.predict_linger_us), 0)
+    # a DENSER ladder than the offline default: coalesced batches land
+    # between 1k and 64k under open-loop load, and the default ladder's
+    # sparse top would pad every ~2k-row batch to 65536 (40x wasted
+    # walk).  Still a closed compiled set — this is exactly the
+    # predict_buckets knob doing its job for the online profile.
+    buckets = (1, 32, 256, 2048, 16384, 65536)
+    # the swap pair: engine A serves a PREFIX of the model, engine B the
+    # full model — the realistic continued-training hot swap, and their
+    # scores differ so a torn request cannot hide
+    ta = max(T - 2, 1)
+    eng_a = ServingEngine(booster.export_flat(ta), buckets=buckets,
+                          shards=shards, linger_us=linger_us)
+    eng_b = ServingEngine(booster.export_flat(), buckets=buckets,
+                          shards=shards, linger_us=linger_us)
+
+    pool_rows = 65536
+    pool, _ = make_data(pool_rows, args.features, seed=7,
+                        narrow_features=narrow)
+    # per-request references for the misscore check: every request is a
+    # contiguous pool slice, so its exact expected scores are a column
+    # slice of one of these
+    ref_a = eng_a.scores(pool)
+    ref_b = eng_b.scores(pool)
+    eng_a.warmup()
+    eng_b.warmup()             # double-buffer: compiled BEFORE the load
+    progs0 = len(costmodel.phase_program_records("predict"))
+
+    # closed-loop capacity estimate prices the offered open-loop rate
+    req_rows = 64
+    t0 = time.perf_counter()
+    calls = 0
+    while time.perf_counter() - t0 < 0.5 or calls < 3:
+        eng_a.scores(pool[:1024])
+        calls += 1
+    cap = 1024 * calls / (time.perf_counter() - t0)
+    # offer well below the closed-loop estimate: at ~capacity the
+    # bounded queue saturates and p99 measures backpressure, not the
+    # serving path.  0.3x keeps the generator truly open-loop.
+    offered = max(cap * 0.3, req_rows * 10.0)
+    interval = req_rows / offered
+
+    def open_loop(front, duration_s, swap_after_s=None, swap_to=None):
+        """Submit pool slices on the open-loop schedule; returns
+        (records, swap_drain_s).  Arrivals follow the wall clock — a
+        slow completion never delays the next submit."""
+        import threading
+        records = []
+        start = time.perf_counter()
+        next_t = start
+        i = 0
+        drain_box = {}
+        swap_thread = None
+        swapped = swap_after_s is None
+        while time.perf_counter() - start < duration_s:
+            if not swapped and time.perf_counter() - start >= swap_after_s:
+                # the swap blocks until the drain-and-flip completes, so
+                # it runs on its OWN thread: the open-loop schedule keeps
+                # submitting INTO the drain window — that concurrency is
+                # exactly what the zero-drop contract is about
+                swap_thread = threading.Thread(
+                    target=lambda: drain_box.__setitem__(
+                        "drain", front.swap_engine(swap_to, warmup=False)))
+                swap_thread.start()
+                swapped = True
+            now = time.perf_counter()
+            if now < next_t:
+                time.sleep(next_t - now)
+            s = (i * req_rows) % (pool_rows - req_rows)
+            rec = {"s": s, "n": req_rows, "t_sub": time.perf_counter()}
+            fut = front.submit(pool[s:s + req_rows])
+            fut.add_done_callback(
+                lambda f, rec=rec: rec.__setitem__(
+                    "t_done", time.perf_counter()))
+            rec["fut"] = fut
+            records.append(rec)
+            next_t += interval
+            i += 1
+        if swap_thread is not None:
+            swap_thread.join(60.0)
+        return records, drain_box.get("drain")
+
+    # ---- phase 1: steady open-loop load on engine A (repeats samples)
+    lats, samples, requests = [], [], 0
+    for _ in range(max(1, args.repeats)):
+        front = ServingFront(eng_a, linger_us=linger_us)
+        t0 = time.perf_counter()
+        records, _ = open_loop(front, duration_s=2.0)
+        front.close()
+        wall = time.perf_counter() - t0
+        done_rows = sum(r["n"] for r in records if "t_done" in r)
+        samples.append(done_rows / wall)
+        lats.extend(r["t_done"] - r["t_sub"] for r in records
+                    if "t_done" in r)
+        requests += len(records)
+
+    # ---- phase 2: the mid-load hot swap (drain-and-flip, zero drops)
+    front = ServingFront(eng_a, linger_us=linger_us)
+    records, drain = open_loop(front, duration_s=2.0, swap_after_s=1.0,
+                               swap_to=eng_b)
+    front.close()
+    dropped = 0
+    misscored = 0
+    for r in records:
+        fut = r["fut"]
+        if not fut.done() or fut.exception() is not None:
+            dropped += 1
+            continue
+        got = np.asarray(fut.result())
+        s, n = r["s"], r["n"]
+        if not (np.array_equal(got, ref_a[:, s:s + n])
+                or np.array_equal(got, ref_b[:, s:s + n])):
+            misscored += 1
+
+    med = float(np.median(samples))
+    out = {
+        "metric": f"serve_rows_per_sec_higgs{train_rows // 1000}k_"
+                  f"trees{T}_leaves{args.leaves}",
+        "unit": "rows/sec",
+        "host": costmodel.host_fingerprint(),
+        "trees": T,
+        "value": round(med, 2),
+        "samples": [round(s, 2) for s in samples],
+        "spread": round((max(samples) - min(samples)) / med, 4)
+                  if med > 0 else 0.0,
+        "serve_rows_per_sec": round(med, 2),
+        "serve_spread": round((max(samples) - min(samples)) / med, 4)
+                        if med > 0 else 0.0,
+        "serve_p50_us": round(1e6 * float(np.percentile(lats, 50)), 1),
+        "serve_p99_us": round(1e6 * float(np.percentile(lats, 99)), 1),
+        "serve_offered_rows_per_sec": round(offered, 2),
+        "serve_requests": requests,
+        "serve_linger_us": linger_us,
+        "serve_recompiles": len(costmodel.phase_program_records("predict"))
+                            - progs0,
+        "serve_dropped": dropped,
+        "serve_misscored": misscored,
+        "serve_swap_drain_ms": round(1e3 * drain, 3)
+                               if drain is not None else None,
+        "serve_coalesced_batches": telemetry.counters().get(
+            "serve/coalesced_batches", 0),
+        "serve_mean_batch_rows": round(
+            telemetry.counters().get("serve/coalesced_rows", 0)
+            / max(telemetry.counters().get("serve/coalesced_batches", 1),
+                  1), 1),
+        "serve_shards_used": eng_a.shards,
+    }
+    snap = telemetry.snapshot()
+    if "roofline" in snap:
+        out["roofline"] = snap["roofline"]
+    if "compile" in snap:
+        out["compile"] = snap["compile"]
+    print(json.dumps(out))
+    return 0
+
+
 # keys the headline bench copies out of the --bench-ingest subprocess
 # (perf_gate gates ingest_rows_per_sec; the A/B, H2D rate and RSS
 # assertion ride along ungated)
@@ -575,11 +794,30 @@ def main() -> int:
                              "predictions/sec and p50/p99 latency per "
                              "batch bucket (1/32/1k/64k), f32 and int8, "
                              "plus the legacy per-tree-scan A/B at 64k")
+    parser.add_argument("--bench-serve", action="store_true",
+                        help="elastic-serving benchmark (ISSUE 13): p99 "
+                             "latency + rows/sec under a concurrent "
+                             "open-loop load generator through the "
+                             "coalescing ServingFront, plus a mid-load "
+                             "drain-and-flip hot swap with dropped/"
+                             "misscored counts (both must be 0)")
+    parser.add_argument("--serve-shards", type=int, default=0,
+                        help="tree-shard the --bench-serve engines over "
+                             "this many devices (0 = single-device; "
+                             "sharded scores are bit-equal by contract)")
+    parser.add_argument("--predict-linger-us", type=int, default=500,
+                        help="ServingFront max coalescing linger for "
+                             "--bench-serve (the predict_linger_us knob)")
     args = parser.parse_args()
     if args.bench_ingest:
         return bench_ingest(args)
     if args.bench_predict:
         return bench_predict(args)
+    if args.bench_serve:
+        if args.serve_shards > 1:
+            import __graft_entry__ as graft
+            graft._provision_devices(max(args.serve_shards, 4))
+        return bench_serve(args)
     if args.bench_wire:
         return bench_wire(args)
     if (args.hist_dtype != "int8" and args.rows > 4_000_000
@@ -976,6 +1214,18 @@ def main() -> int:
                   ["--bench-predict", "--max-bin", str(args.max_bin),
                    "--iters", str(args.iters)],
                   [(k, k) for k in PREDICT_COPY_KEYS])
+
+    run_serve = not args.skip_parity
+    if run_serve:
+        # elastic-serving lane (ISSUE 13): p99 + rows/sec under the
+        # open-loop load generator through the coalescing front, and the
+        # mid-load hot swap's dropped/misscored counts.  perf_gate gates
+        # serve_rows_per_sec (rate), serve_p99_us (must-not-grow) and
+        # flags ANY nonzero recompile/dropped/misscored absolutely.
+        sub_bench("serve",
+                  ["--bench-serve", "--max-bin", str(args.max_bin),
+                   "--iters", str(args.iters)],
+                  [(k, k) for k in SERVE_COPY_KEYS])
 
     run_ingest = not args.skip_parity
     if run_ingest:

@@ -77,31 +77,39 @@ def _hist_int8_dp_program() -> Program:
                    ((DATA_AXIS, 2),), F, B)
 
 
-def _serving_programs() -> "List[Program]":
+def _serving_arrays(T: int, seed: int = 3):
+    """Shared serving-program inputs: ``T`` chain trees over the small
+    schema (node k -> left leaf ~k, right node k+1; last right a leaf)."""
     import numpy as np
     import jax.numpy as jnp
-    from ..ops.scoring import bfs_scores_impl, bfs_scores_int8_impl
-    rng = np.random.RandomState(3)
-    T, max_nodes, max_leaves, depth = 3, 4, 5, 3
+    rng = np.random.RandomState(seed)
+    max_nodes, max_leaves, depth = 4, 5, 3
     codes = jnp.asarray(rng.randint(0, B, size=(F, N)).astype(np.int32))
     sf = jnp.asarray(rng.randint(0, F, size=(T, max_nodes)).astype(np.int32))
     tr = jnp.asarray(rng.randint(0, B, size=(T, max_nodes)).astype(np.int32))
-    # chain trees: node k -> left leaf ~k, right node k+1 (last: leaf)
     lc = jnp.asarray(np.tile(~np.arange(max_nodes), (T, 1)).astype(np.int32))
     rc_row = np.arange(1, max_nodes + 1)
     rc_row[-1] = ~max_nodes
     rc = jnp.asarray(np.tile(rc_row, (T, 1)).astype(np.int32))
     leaf_value = jnp.asarray(rng.randn(T, max_leaves).astype(np.float32))
+    leaf_q = jnp.asarray(rng.randint(-127, 128,
+                                     size=(T, max_leaves)).astype(np.int8))
+    scale = jnp.asarray((rng.rand(T) + 0.5).astype(np.float32))
     root_state = jnp.zeros((T,), jnp.int32)
     tree_class = jnp.zeros((T,), jnp.int32)
+    return (codes, sf, tr, lc, rc, leaf_value, leaf_q, scale, root_state,
+            tree_class, depth)
+
+
+def _serving_programs() -> "List[Program]":
+    from ..ops.scoring import bfs_scores_impl, bfs_scores_int8_impl
+    (codes, sf, tr, lc, rc, leaf_value, leaf_q, scale, root_state,
+     tree_class, depth) = _serving_arrays(3)
     f32 = Program(
         "serve/bfs_f32",
         functools.partial(bfs_scores_impl, max_depth=depth, num_class=1),
         (codes, sf, tr, lc, rc, leaf_value, root_state, tree_class),
         (), F, B)
-    leaf_q = jnp.asarray(rng.randint(-127, 128,
-                                     size=(T, max_leaves)).astype(np.int8))
-    scale = jnp.asarray((rng.rand(T) + 0.5).astype(np.float32))
     int8 = Program(
         "serve/bfs_int8",
         functools.partial(bfs_scores_int8_impl, max_depth=depth,
@@ -109,6 +117,49 @@ def _serving_programs() -> "List[Program]":
         (codes, sf, tr, lc, rc, leaf_q, scale, root_state, tree_class),
         (), F, B)
     return [f32, int8]
+
+
+def sharded_serving_program(quantize: str = "float32",
+                            shards: int = 2) -> Program:
+    """The tree-sharded serving BFS program (ISSUE 13): the sharded
+    score impl shard_mapped over a real ``("tree",)`` mesh, exactly as
+    ``ServingEngine._sharded_mapped`` builds it — so graftlint J2's
+    collective census covers the tree-axis exchange seams
+    (``serve/tree_carry`` ppermute chain + the ``serve/tree_psum``
+    masked broadcast) against what XLA will actually execute."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from ..ops.scoring import (bfs_scores_sharded_impl,
+                               bfs_scores_sharded_int8_impl)
+    from ..parallel.learners import shard_map
+    from ..parallel.mesh import TREE_AXIS, get_serving_mesh
+
+    if len(jax.devices()) < shards:
+        raise RuntimeError(
+            "jaxpr layer needs %d devices (set XLA_FLAGS="
+            "--xla_force_host_platform_device_count=8 before importing "
+            "jax, as scripts/graftlint.py and tests/conftest.py do)"
+            % shards)
+    (codes, sf, tr, lc, rc, leaf_value, leaf_q, scale, root_state,
+     tree_class, depth) = _serving_arrays(4, seed=5)
+    mesh = get_serving_mesh(shards)
+    t2, t1 = P(TREE_AXIS, None), P(TREE_AXIS)
+    if quantize == "int8":
+        impl = functools.partial(
+            bfs_scores_sharded_int8_impl, max_depth=depth, num_class=1,
+            num_trees=4, shards=shards, axis_name=TREE_AXIS)
+        in_specs = (P(), t2, t2, t2, t2, t2, t1, t1, t1)
+        args = (codes, sf, tr, lc, rc, leaf_q, scale, root_state,
+                tree_class)
+    else:
+        impl = functools.partial(
+            bfs_scores_sharded_impl, max_depth=depth, num_class=1,
+            num_trees=4, shards=shards, axis_name=TREE_AXIS)
+        in_specs = (P(), t2, t2, t2, t2, t2, t1, t1)
+        args = (codes, sf, tr, lc, rc, leaf_value, root_state, tree_class)
+    mapped = shard_map(impl, mesh=mesh, in_specs=in_specs, out_specs=P())
+    return Program("serve/bfs_sharded_%s" % quantize, mapped, args, (),
+                   F, B)
 
 
 def parallel_grow_program(tree_learner: str, hist_dtype: str = "float32",
@@ -175,6 +226,11 @@ def canonical_programs(parallel: bool = True) -> "List[Program]":
             parallel_grow_program("data", hist_dtype="int8"),
             parallel_grow_program("hybrid"),
             parallel_grow_program("voting"),
+            # tree-sharded serving (ISSUE 13): the census proves the
+            # serve/tree_carry + serve/tree_psum seams cover every
+            # collective the sharded walk executes, f32 and int8
+            sharded_serving_program("float32"),
+            sharded_serving_program("int8"),
         ])
     return programs
 

@@ -27,6 +27,22 @@ for A/B).  All four are score transforms of the SAME model — only
 ``predict_quantize=int8`` changes values, by the documented quantization
 step.
 
+Distributed elastic serving knobs (ISSUE 13 — same module):
+``serve_shards`` shards the flattened ensemble's [T, ...] node tables
+contiguously over a 1-D ``("tree",)`` device mesh (0 = single-device;
+>1 must not exceed the available devices — loud reject, never a silent
+shrink); sharded scores stay BIT-equal to the single-device engine
+(f32 and int8) via the canonical-order carry chain + one masked psum
+(``serve/tree_psum``).  ``predict_linger_us`` is the cross-request
+coalescing front's max linger (a queued request dispatches at latest
+this long after its batch's first arrival; 0 = immediately) and
+``predict_queue`` bounds in-flight work in top-bucket batches (the
+front's queue blocks when full — backpressure, never load shedding —
+and ``predict_file`` keeps that many parsed chunks in flight).  All
+three are score-invariant: they change latency/placement, never a
+result bit (``predict_algo=scan`` composes with none of them beyond
+``serve_shards=0`` — the replay is the single-device A/B).
+
 Parallel-training knobs (ISSUE 9 — lightgbm_tpu/parallel/):
 ``tree_learner`` now spans ``serial|feature|data|hybrid|voting``.
 ``hybrid`` trains on an explicit 2-D ``(data, feature)`` mesh —
@@ -275,6 +291,12 @@ class Application:
         predictor.predict_file(self.config.io_config.data_filename,
                                self.config.io_config.output_result,
                                self.config.io_config.has_header)
+        if telemetry.enabled():
+            # the predict task has no training loop to write the final
+            # totals record: emit it here so metrics_out= predict runs
+            # carry the serve/* family (and the predict-phase roofline)
+            # into the sink telemetry_report.py renders
+            telemetry.emit_summary()
         log.info("Finished prediction")
 
 

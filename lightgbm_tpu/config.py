@@ -192,6 +192,26 @@ class IOConfig:
     # "scan" keeps the training-side per-tree replay (O(T·L) steps) as
     # the A/B reference bench.py's bench_predict lane prices.
     predict_algo: str = "bfs"
+    # Distributed elastic serving (ISSUE 13, lightgbm_tpu/serving.py).
+    # serve_shards: shard the flattened ensemble's [T, ...] node tables
+    # contiguously along a 1-D ("tree",) device mesh — each device holds
+    # ONLY its tree block (the 10k+-tree / multi-GB-ensemble regime one
+    # HBM cannot hold); scores stay BIT-equal to the single-device
+    # engine (f32 and int8).  0 = single-device; >1 must not exceed the
+    # available devices (the engine rejects loudly, never shrinks).
+    serve_shards: int = 0
+    # predict_linger_us: the ServingFront's max coalescing wait — a
+    # queued request is dispatched no later than this many microseconds
+    # after the FIRST request of its batch arrived (sooner when a full
+    # top-bucket batch is available).  0 = dispatch immediately (still
+    # coalesces whatever is queued at pop time).
+    predict_linger_us: int = 200
+    # predict_queue: bound on in-flight serving work, in TOP-BUCKET
+    # batches — the ServingFront's queue holds at most
+    # predict_queue * max(predict_buckets) rows (submit blocks when
+    # full: backpressure, never load shedding), and predict_file keeps
+    # this many parsed chunks in flight ahead of the device.
+    predict_queue: int = 4
     is_pre_partition: bool = False
     is_enable_sparse: bool = True
     # Streaming ingestion (ISSUE 8, lightgbm_tpu/io/streaming.py):
@@ -302,6 +322,21 @@ class IOConfig:
             log.check(value in ("bfs", "scan"),
                       "predict_algo must be bfs or scan")
             self.predict_algo = value
+        self.serve_shards = _get_int(params, "serve_shards",
+                                     self.serve_shards)
+        log.check(self.serve_shards >= 0,
+                  "serve_shards should be >= 0 (0 = single-device)")
+        if self.serve_shards > 1 and self.predict_algo == "scan":
+            log.fatal("serve_shards > 1 requires predict_algo=bfs (the "
+                      "per-tree scan replay is a single-device A/B path)")
+        self.predict_linger_us = _get_int(params, "predict_linger_us",
+                                          self.predict_linger_us)
+        log.check(self.predict_linger_us >= 0,
+                  "predict_linger_us should be >= 0")
+        self.predict_queue = _get_int(params, "predict_queue",
+                                      self.predict_queue)
+        log.check(self.predict_queue >= 1,
+                  "predict_queue should be >= 1 (in-flight batches)")
         self.is_pre_partition = _get_bool(params, "is_pre_partition", self.is_pre_partition)
         self.is_enable_sparse = _get_bool(params, "is_enable_sparse", self.is_enable_sparse)
         if "streaming" in params:

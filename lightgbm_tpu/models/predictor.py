@@ -65,23 +65,35 @@ class Predictor:
 
     def predict_file(self, data_filename: str, result_filename: str,
                      has_header: bool, chunk_lines: int = 500_000) -> None:
-        """Predictor::Predict (predictor.hpp:109-197).
+        """Predictor::Predict (predictor.hpp:109-197) — streamed
+        out-of-core scoring (ISSUE 13 axis d).
 
-        Streams the file in bounded chunks (the reference predicts
-        line-by-line off a pipelined reader; here a prefetcher thread
-        reads the next chunk while the current one predicts), so the raw
-        feature matrix never materializes whole.  The ensemble encode is
-        NOT per-chunk: the engine built in __init__ carries it."""
+        The file chunks through the streaming parse→encode path: the
+        background pipeline reads AND parses up to ``predict_queue``
+        chunks ahead (the PR 8 double-buffer idea applied to scoring —
+        host tokenization of chunk i+1 hides behind the device walk of
+        chunk i), so neither the raw feature matrix nor the score vector
+        ever materializes whole and a 100M+-row file scores in bounded
+        host memory.  Scores are row-independent through the engine
+        (bucket padding never leaks), so the output file is
+        BYTE-IDENTICAL at any chunk length — tests pin streamed ==
+        resident.  The ensemble encode is NOT per-chunk: the engine
+        built in __init__ carries it."""
         parser = parser_mod.create_parser(data_filename, has_header,
                                           self.num_features,
                                           self.boosting.label_idx)
+        lines_iter = parser_mod.read_line_chunks(
+            data_filename, skip_header=has_header, chunk_lines=chunk_lines)
+
+        def _parsed_features():
+            for lines in lines_iter:
+                yield parser.parse(lines).features
+
+        depth = max(int(getattr(self.engine, "queue", 2)), 1)
         with open(result_filename, "w") as f:
-            for lines in parser_mod.prefetch_chunks(
-                    parser_mod.read_line_chunks(
-                        data_filename, skip_header=has_header,
-                        chunk_lines=chunk_lines)):
-                parsed = parser.parse(lines)
-                result = self.predict_matrix(parsed.features)
+            for features in parser_mod.prefetch_chunks(_parsed_features(),
+                                                       depth=depth):
+                result = self.predict_matrix(features)
                 if result.ndim == 1:
                     for v in result:
                         f.write(_fmt(v) + "\n")

@@ -232,6 +232,100 @@ def bfs_leaf_indices_impl(codes, split_feature, threshold_rank, left_child,
                            left_child, right_child, root_state, max_depth)
 
 
+# ------------------------------------------------------- tree-axis sharding
+#
+# ISSUE 13: the lockstep BFS walk is embarrassingly parallel in T — each
+# shard of a 1-D ("tree",) mesh walks its CONTIGUOUS block of trees
+# ([Tb, N] frontier over its own [Tb, max_nodes] node tables, the only
+# tables resident in its HBM — the 10k+-tree / multi-GB-ensemble regime a
+# single device cannot hold).  The only cross-shard work is the final
+# score accumulation, and bit-equality with the single-device engine
+# pins its design: the single-device accumulate is a sequential LEFT
+# FOLD over trees in canonical order (``_accumulate_tree_scores``), and
+# f32 addition is not associative, so a psum of per-shard partials would
+# regroup the sum and drift by ulps.  Instead the partial [C, N] score
+# is CARRIED shard-to-shard along the tree axis (ppermute chain, shard s
+# folds its block onto the running total from shards 0..s-1 — exactly
+# the single-device add sequence, including NaN/Inf propagation), and
+# ONE masked psum at the end broadcasts the final shard's total (every
+# other contribution is +0.0; the running score can never be -0.0 — it
+# starts at +0.0 and IEEE round-to-nearest never produces -0.0 from
+# x + y with x != -0.0 or y != -0.0 — so adding the zeros is exact).
+
+
+def _sharded_tree_accumulate(vals, tree_class, *, num_class: int,
+                             num_trees: int, shards: int, axis_name: str):
+    """[C, N] ensemble sums from per-shard tree values ``vals`` [Tb, N],
+    bit-equal to ``_accumulate_tree_scores`` over the canonically-ordered
+    full [T, N] (see block comment).  ``tree_class`` is this shard's
+    [Tb] slice of the global class map; ``num_trees`` masks the pad
+    trees a non-dividing T leaves on the last shard (skipped entirely —
+    never added, not even as zeros)."""
+    from .. import telemetry
+
+    Tb, N = vals.shape
+    idx = jax.lax.axis_index(axis_name)
+    base = idx * Tb
+
+    def fold(carry):
+        def add(t, score):
+            new = score.at[tree_class[t]].add(vals[t])
+            return jnp.where(base + t < num_trees, new, score)
+        return jax.lax.fori_loop(0, Tb, add, carry)
+
+    carry = jnp.zeros((num_class, N), jnp.float32)
+    if shards <= 1:
+        return fold(carry)
+    # the carry chain: shard s's fold result travels to shard s+1, which
+    # folds its own block on top — S-1 hops of one [C, N] payload
+    send = telemetry.collective_span(
+        "serve/tree_carry",
+        lambda x: jax.lax.ppermute(
+            x, axis_name, [(i, i + 1) for i in range(shards - 1)]),
+        kind="ppermute", axis=axis_name, phase="predict")
+    for _ in range(shards - 1):
+        carry = send(fold(carry))
+    chain = fold(carry)       # complete on the LAST shard only
+    tree_psum = telemetry.collective_span(
+        "serve/tree_psum", lambda x: jax.lax.psum(x, axis_name),
+        kind="psum", axis=axis_name, phase="predict")
+    return tree_psum(jnp.where(idx == shards - 1, chain,
+                               jnp.zeros_like(chain)))
+
+
+def bfs_scores_sharded_impl(codes, split_feature, threshold_rank,
+                            left_child, right_child, leaf_value, root_state,
+                            tree_class, *, max_depth: int, num_class: int,
+                            num_trees: int, shards: int, axis_name: str):
+    """Tree-sharded f32 variant of ``bfs_scores_impl`` (one shard of the
+    1-D tree mesh: per-shard [Tb, ...] node tables, replicated codes;
+    see the sharding block comment).  Returns the REPLICATED [C, N]
+    sums, bit-equal to the single-device walk."""
+    leaf = _bfs_leaf_state(codes, split_feature, threshold_rank,
+                           left_child, right_child, root_state, max_depth)
+    vals = jnp.take_along_axis(leaf_value, leaf, axis=1)   # [Tb, N] f32
+    return _sharded_tree_accumulate(vals, tree_class, num_class=num_class,
+                                    num_trees=num_trees, shards=shards,
+                                    axis_name=axis_name)
+
+
+def bfs_scores_sharded_int8_impl(codes, split_feature, threshold_rank,
+                                 left_child, right_child, leaf_q, leaf_scale,
+                                 root_state, tree_class, *, max_depth: int,
+                                 num_class: int, num_trees: int, shards: int,
+                                 axis_name: str):
+    """Tree-sharded int8 variant: per-shard int8 leaf block + per-tree
+    scales, the same exact one-hot read and accumulation order as the
+    single-device ``bfs_scores_int8_impl``."""
+    leaf = _bfs_leaf_state(codes, split_feature, threshold_rank,
+                           left_child, right_child, root_state, max_depth)
+    qvals = batched_int8_table_lookup(leaf_q, leaf)        # [Tb, N] f32
+    vals = qvals * leaf_scale[:, None]
+    return _sharded_tree_accumulate(vals, tree_class, num_class=num_class,
+                                    num_trees=num_trees, shards=shards,
+                                    axis_name=axis_name)
+
+
 # Module-level jitted conveniences (tests, ad-hoc callers).  The serving
 # engine builds its OWN jits from the impls above so it can donate the
 # codes buffer and instrument each program through costmodel.

@@ -20,6 +20,9 @@ from ..utils import log
 
 DATA_AXIS = "data"
 FEATURE_AXIS = "feature"
+# serving-side tree-sharded ensembles (ISSUE 13): the [T, max_nodes] node
+# tables shard along this axis, rows (codes) are replicated
+TREE_AXIS = "tree"
 
 
 def init_distributed(config=None) -> None:
@@ -183,6 +186,29 @@ def get_mesh2d(num_machines: Optional[int] = None,
     ds, fs = factor_machines(num_machines, feature_shards, voting=voting)
     grid = np.array(devices[:ds * fs]).reshape(ds, fs)
     return Mesh(grid, (DATA_AXIS, FEATURE_AXIS))
+
+
+def get_serving_mesh(shards: int, device_type: str = "") -> Mesh:
+    """1-D ``("tree",)`` mesh over the first ``shards`` devices for the
+    tree-sharded serving engine (ISSUE 13): ``FlatEnsemble``'s
+    [T, max_nodes] node tables shard contiguously along the tree axis —
+    each device's HBM holds ONLY its tree block, which is what lifts the
+    multi-GB-ensemble regime — while the codes batch is replicated.
+
+    Loud error when ``shards`` exceeds the available devices: a silent
+    shrink (the training meshes' linkers_socket behavior) would change
+    the documented shard layout AND the serve/tree_* wire bytes the
+    telemetry interconnect block prices, mid-deployment."""
+    devices = jax.devices(device_type) if device_type else jax.devices()
+    shards = int(shards)
+    if shards < 1:
+        log.fatal("serve_shards must be >= 1 to build a serving mesh "
+                  "(got %d)" % shards)
+    if shards > len(devices):
+        log.fatal("serve_shards=%d exceeds available devices (%d) — the "
+                  "tree-sharded engine never silently shrinks its mesh"
+                  % (shards, len(devices)))
+    return Mesh(np.array(devices[:shards]), (TREE_AXIS,))
 
 
 def dataset_row_sharding(num_rows: int, shard_rows: bool = False,

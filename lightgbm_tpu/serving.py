@@ -30,13 +30,47 @@ server actually has:
    scale (quarter table traffic, single-pass bf16 one-hot read; routing
    stays exact — only leaf VALUES are quantized).
 
+Distributed, elastic serving (ISSUE 13) adds three axes on top:
+
+3. **Tree-axis sharding** (``shards=`` / the ``serve_shards`` knob): the
+   [T, ...] node tensors shard CONTIGUOUSLY along a 1-D ``("tree",)``
+   mesh (``parallel.mesh.get_serving_mesh``) — each device's HBM holds
+   only its tree block, lifting the 10k+-tree / multi-GB-ensemble
+   regime a single HBM cannot hold.  The BFS walk is embarrassingly
+   parallel in T; the per-shard [C, N] partials are accumulated in
+   canonical tree order and carried shard-to-shard (ppermute chain)
+   with ONE masked psum at the end (``serve/tree_psum``), so sharded
+   scores stay BIT-EQUAL to the single-device engine, f32 and int8
+   (ops/scoring.py "tree-axis sharding" block comment has the proof
+   sketch).
+
+4. **Cross-request batching** (``ServingFront``): a coalescing queue in
+   front of the engine — incoming requests pack onto the SAME bucket
+   ladder under a max-linger deadline (``predict_linger_us``), scores
+   scatter back per request (rows are independent through the walk, so
+   coalescing never changes a result bit).  The queue is BOUNDED
+   (``predict_queue`` top-bucket batches); when full, ``submit``
+   blocks — backpressure, never load shedding, which is what makes the
+   zero-drop contract testable.
+
+5. **Hot swap** (``ServingFront.swap_engine``): double-buffered engine
+   replacement — the NEW engine warms its bucket programs while the old
+   one serves (``ServingEngine.warmup``), then a swap marker rides the
+   request queue and the worker flips atomically when it drains to it.
+   Requests enqueued before the swap score on the old engine, after it
+   on the new one; none are dropped or torn across engines.
+
 Programs are costmodel-instrumented under phase "predict" (roofline
 attribution + compile observability ride along whenever telemetry is
 armed), and the engine files ``serve/*`` counters.
 """
 from __future__ import annotations
 
-from typing import Dict, Optional, Sequence, Tuple
+import collections
+import threading
+import time
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -183,7 +217,8 @@ class ServingEngine:
     def __init__(self, flat: FlatEnsemble,
                  buckets: Sequence[int] = DEFAULT_BUCKETS,
                  quantize: str = "float32", donate: str = "auto",
-                 algo: str = "bfs"):
+                 algo: str = "bfs", shards: int = 0, linger_us: int = 200,
+                 queue: int = 4, device_type: str = ""):
         if quantize not in ("float32", "int8"):
             raise ValueError("quantize must be float32 or int8")
         if algo not in ("bfs", "scan"):
@@ -191,13 +226,38 @@ class ServingEngine:
         buckets = tuple(sorted({int(b) for b in buckets}))
         if not buckets or buckets[0] < 1:
             raise ValueError("buckets must be positive ints")
+        shards = int(shards)
+        if shards < 0:
+            raise ValueError("shards must be >= 0 (0 = single-device)")
+        if int(linger_us) < 0:
+            raise ValueError("linger_us must be >= 0")
+        if int(queue) < 1:
+            raise ValueError("queue must be >= 1 (in-flight batches)")
         self.flat = flat
         self.buckets = buckets
         self.quantize = quantize
         self.algo = algo
         self.donate = self._resolve_donate(donate)
+        # tree-axis sharding (ISSUE 13): 0/1 = the single-device engine,
+        # >1 = contiguous tree blocks over a ("tree",) mesh.  The mesh is
+        # built EAGERLY so an over-subscribed shard count fails at engine
+        # construction, not at the first request.
+        self.shards = shards if shards > 1 else 1
+        self.device_type = device_type
+        self._mesh = None
+        if self.shards > 1:
+            if algo == "scan":
+                raise ValueError(
+                    "predict_algo=scan cannot tree-shard (the per-tree "
+                    "replay is a single-device A/B path); use bfs")
+            from .parallel.mesh import get_serving_mesh
+            self._mesh = get_serving_mesh(self.shards, device_type)
+        # ServingFront defaults (axis b): carried on the engine so
+        # engine_options_from_config stays the single IOConfig mapping
+        self.linger_us = int(linger_us)
+        self.queue = int(queue)
         self._tables = None            # device-resident node tensors
-        self._programs: Dict[str, object] = {}
+        self._programs: Dict[tuple, object] = {}
 
     @staticmethod
     def _resolve_donate(donate: str) -> bool:
@@ -218,10 +278,48 @@ class ServingEngine:
     def _device_tables(self):
         """Push the flattened tensors to device ONCE (cached jnp arrays;
         re-used by every bucketed call — steady-state serving transfers
-        only the codes buffer)."""
+        only the codes buffer).  Under ``shards > 1`` the [T, ...]
+        tables are padded to a shard multiple with inert stump trees
+        (root ~0, zero leaves — additionally MASKED out of the
+        accumulate by the static true tree count) and committed with a
+        tree-axis NamedSharding, so each mesh device holds ONLY its
+        contiguous tree block."""
         if self._tables is None:
             import jax.numpy as jnp
             f = self.flat
+            if self.shards > 1:
+                import jax
+                from jax.sharding import NamedSharding, PartitionSpec
+                from .parallel.mesh import TREE_AXIS
+                pad = (-f.num_trees) % self.shards
+
+                def put(arr, fill=0):
+                    arr = np.asarray(arr)
+                    if pad:
+                        widths = [(0, pad)] + [(0, 0)] * (arr.ndim - 1)
+                        arr = np.pad(arr, widths, constant_values=fill)
+                    spec = PartitionSpec(TREE_AXIS,
+                                         *([None] * (arr.ndim - 1)))
+                    return jax.device_put(
+                        arr, NamedSharding(self._mesh, spec))
+
+                t = {
+                    "sf": put(f.split_feature),
+                    "tr": put(f.threshold_rank),
+                    "lc": put(f.left_child),
+                    "rc": put(f.right_child),
+                    "root": put(f.root_state, fill=-1),
+                    "tc": put(f.tree_class),
+                    "nl": put(f.num_leaves, fill=1),
+                }
+                if self.quantize == "int8":
+                    q, scale = f.int8_tables()
+                    t["lv_q"] = put(q)
+                    t["lv_scale"] = put(scale, fill=1)
+                else:
+                    t["lv"] = put(f.leaf_value)
+                self._tables = t
+                return self._tables
             t = {
                 "sf": jnp.asarray(f.split_feature),
                 "tr": jnp.asarray(f.threshold_rank),
@@ -248,12 +346,26 @@ class ServingEngine:
         """One costmodel-instrumented jit per kind ("scores"/"leaves");
         bucket shapes are signatures of the SAME program object, so the
         compiled-program inventory stays a closed set (the no-recompile
-        assertion tests/test_serving.py pins via the compile counters)."""
-        prog = self._programs.get(kind)
-        if prog is None:
-            import jax
+        assertion tests/test_serving.py pins via the compile counters).
 
+        The cache key carries the resolved backend + device_type + shard
+        count beside the kind (the graftlint R2 rule class): a
+        mid-process backend flip — or two engines at different shard
+        counts sharing a future program registry — must never reuse a
+        program compiled for the other routing."""
+        import jax
+        key = (kind, jax.default_backend(), self.device_type, self.shards)
+        prog = self._programs.get(key)
+        if prog is None:
             from .ops import scoring
+            tag = "_int8" if (self.quantize == "int8"
+                              and kind == "scores") else ""
+            if self.shards > 1:
+                fn = self._sharded_mapped(kind, scoring)
+                prog = costmodel.instrument(
+                    f"serve/bfs_{kind}{tag}_sharded", fn, phase="predict")
+                self._programs[key] = prog
+                return prog
             donate = (0,) if self.donate else ()
             if kind == "scores":
                 impl = (scoring.bfs_scores_int8_impl
@@ -266,12 +378,54 @@ class ServingEngine:
                 fn = jax.jit(scoring.bfs_leaf_indices_impl,
                              static_argnames=("max_depth",),
                              donate_argnums=donate)
-            tag = "_int8" if (self.quantize == "int8"
-                              and kind == "scores") else ""
             prog = costmodel.instrument(f"serve/bfs_{kind}{tag}", fn,
                                         phase="predict")
-            self._programs[kind] = prog
+            self._programs[key] = prog
         return prog
+
+    def _sharded_mapped(self, kind: str, scoring):
+        """The tree-sharded program body: the sharded impl with its
+        statics bound, shard_mapped over the ("tree",) mesh — codes
+        replicated, node tables tree-sharded, scores replicated out
+        (the in-program carry chain + masked psum already leave every
+        shard holding the full [C, N] result).  Donation is skipped:
+        the codes buffer is replicated over the mesh, so there is no
+        per-device buffer to recycle in place."""
+        import functools
+
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from .parallel.learners import shard_map
+        from .parallel.mesh import TREE_AXIS
+        f = self.flat
+        t2 = P(TREE_AXIS, None)
+        t1 = P(TREE_AXIS)
+        if kind == "scores":
+            if self.quantize == "int8":
+                impl = functools.partial(
+                    scoring.bfs_scores_sharded_int8_impl,
+                    max_depth=f.max_depth, num_class=f.num_class,
+                    num_trees=f.num_trees, shards=self.shards,
+                    axis_name=TREE_AXIS)
+                in_specs = (P(), t2, t2, t2, t2, t2, t1, t1, t1)
+            else:
+                impl = functools.partial(
+                    scoring.bfs_scores_sharded_impl,
+                    max_depth=f.max_depth, num_class=f.num_class,
+                    num_trees=f.num_trees, shards=self.shards,
+                    axis_name=TREE_AXIS)
+                in_specs = (P(), t2, t2, t2, t2, t2, t1, t1)
+            out_specs = P()
+        else:
+            impl = functools.partial(scoring.bfs_leaf_indices_impl,
+                                     max_depth=f.max_depth)
+            in_specs = (P(), t2, t2, t2, t2, t1)
+            # leaf ids need no exchange at all: the per-shard [Tb, N]
+            # blocks reassemble along the tree axis in the output spec
+            out_specs = t2
+        return jax.jit(shard_map(impl, mesh=self._mesh,
+                                 in_specs=in_specs, out_specs=out_specs))
 
     def _run_scores(self, codes_chunk):
         import jax.numpy as jnp
@@ -290,6 +444,14 @@ class ServingEngine:
                 t["rc"], t["lv"], t["nl"], t["tc"],
                 max_nodes=f.max_nodes, num_class=f.num_class)
         prog = self._program("scores")
+        if self.shards > 1:
+            # statics are partial-bound inside the shard_mapped program
+            if self.quantize == "int8":
+                return prog(jnp.asarray(codes_chunk), t["sf"], t["tr"],
+                            t["lc"], t["rc"], t["lv_q"], t["lv_scale"],
+                            t["root"], t["tc"])
+            return prog(jnp.asarray(codes_chunk), t["sf"], t["tr"],
+                        t["lc"], t["rc"], t["lv"], t["root"], t["tc"])
         if self.quantize == "int8":
             return prog(jnp.asarray(codes_chunk), t["sf"], t["tr"],
                         t["lc"], t["rc"], t["lv_q"], t["lv_scale"],
@@ -308,6 +470,10 @@ class ServingEngine:
             return ensemble_leaf_indices(
                 jnp.asarray(codes_chunk), t["sf"], t["tr"], t["lc"],
                 t["rc"], t["nl"], max_nodes=f.max_nodes)
+        if self.shards > 1:
+            return self._program("leaves")(
+                jnp.asarray(codes_chunk), t["sf"], t["tr"], t["lc"],
+                t["rc"], t["root"])
         return self._program("leaves")(
             jnp.asarray(codes_chunk), t["sf"], t["tr"], t["lc"], t["rc"],
             t["root"], max_depth=f.max_depth)
@@ -364,22 +530,307 @@ class ServingEngine:
                 axis=1))
 
     def leaf_indices(self, features: np.ndarray) -> np.ndarray:
-        """[N, T] leaf index per tree (PredictLeafIndex layout)."""
+        """[N, T] leaf index per tree (PredictLeafIndex layout).  The
+        row slice strips the inert pad trees a sharded engine appends to
+        reach a shard multiple (a no-op single-device, where the device
+        result has exactly num_trees rows)."""
         if self.flat.num_trees == 0:
             return np.zeros((features.shape[0], 0), np.int32)
+        T = self.flat.num_trees
         return self._bucketed(
             features, self._run_leaves,
             lambda outs: np.concatenate(
-                [np.asarray(o, np.int32)[:, :n].T for o, n in outs],
+                [np.asarray(o, np.int32)[:T, :n].T for o, n in outs],
                 axis=0))
+
+    def warmup(self, buckets: Optional[Sequence[int]] = None):
+        """Compile the scores program at every bucket shape ahead of
+        serving — the hot-swap double-buffer step: the NEW engine warms
+        while the OLD one keeps serving, so the drain-and-flip never
+        pays a compile inside the request path (and ``bench_serve``'s
+        ``serve_recompiles=0`` stays true across a swap).  Returns self
+        so ``ServingFront.swap_engine(engine.warmup())`` chains."""
+        if self.flat.num_trees == 0:
+            return self
+        F = max(len(self.flat.used), 1)
+        with telemetry.span("predict_warmup"):
+            for b in (buckets if buckets is not None else self.buckets):
+                codes = np.zeros((F, int(b)), np.int32)
+                np.asarray(self._run_scores(codes))
+        telemetry.count("serve/warmups")
+        return self
+
+
+class _FrontRequest:
+    __slots__ = ("features", "future", "rows", "t_submit")
+
+    def __init__(self, features, future, rows, t_submit):
+        self.features = features
+        self.future = future
+        self.rows = rows
+        self.t_submit = t_submit
+
+
+class _SwapMarker:
+    __slots__ = ("engine", "event", "t0")
+
+    def __init__(self, engine, event, t0):
+        self.engine = engine
+        self.event = event
+        self.t0 = t0
+
+
+class ServingFront:
+    """Cross-request coalescing front over a ServingEngine (ISSUE 13
+    axes b + c — see the module docstring).
+
+    One worker thread drains a bounded request queue: it waits up to
+    ``linger_us`` past the FIRST queued request's arrival (or until a
+    top-bucket batch is available), concatenates whole requests onto one
+    batch, runs ``engine.scores`` once, and scatters the score columns
+    back to each request's Future.  Rows are independent through the
+    BFS walk and the per-class accumulation, so a coalesced request's
+    scores are bit-identical to scoring it alone.
+
+    The queue is bounded at ``queue`` top-bucket batches of rows:
+    ``submit`` BLOCKS when full (backpressure) — the front never sheds
+    load, which is what makes the zero-drop hot-swap contract testable.
+
+    ``swap_engine(new_engine)`` is the drain-and-flip atomic hot swap:
+    the marker rides the queue, requests ahead of it score on the old
+    engine, requests behind it (and everything submitted after the call
+    returns) on the new one — no request is dropped or torn across
+    engines.  Pass an already-``warmup()``-ed engine (the default warms
+    it for you) so the flip never pays a compile in the request path.
+
+    Telemetry (``serve/front_*`` / ``serve/coalesced_*`` /
+    ``serve/linger_wait_us`` / ``serve/queue_depth_*`` /
+    ``serve/swaps`` / ``serve/swap_drain_us``) files alongside the
+    engine's own counters; ``stats`` carries the host-side mirror."""
+
+    def __init__(self, engine: ServingEngine,
+                 linger_us: Optional[int] = None,
+                 queue: Optional[int] = None):
+        self._engine = engine
+        self.linger_s = (engine.linger_us if linger_us is None
+                         else int(linger_us)) / 1e6
+        batches = engine.queue if queue is None else int(queue)
+        if batches < 1:
+            raise ValueError("queue must be >= 1 (in-flight batches)")
+        self.queue_rows = batches * engine.buckets[-1]
+        self._cond = threading.Condition()
+        self._queue: "collections.deque" = collections.deque()
+        self._queued_rows = 0
+        self._closed = False
+        self.stats = {"requests": 0, "rows": 0, "batches": 0,
+                      "coalesced_rows": 0, "queue_peak_rows": 0,
+                      "linger_wait_s": 0.0, "swaps": 0,
+                      "last_swap_drain_s": None}
+        self._thread = threading.Thread(target=self._serve_loop,
+                                        name="lgbm-serving-front",
+                                        daemon=True)
+        self._thread.start()
+
+    @property
+    def engine(self) -> ServingEngine:
+        return self._engine
+
+    # ------------------------------------------------------------ requests
+
+    def submit(self, features: np.ndarray) -> Future:
+        """Enqueue one request ([n, F] raw features); returns a Future
+        resolving to the engine's [num_class, n] raw score sums.  Blocks
+        while the bounded queue is full (backpressure, never drops)."""
+        features = np.asarray(features)
+        if features.ndim != 2:
+            raise ValueError("submit expects a [rows, features] matrix")
+        n = features.shape[0]
+        fut: Future = Future()
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("ServingFront is closed")
+            while self._queued_rows > 0 \
+                    and self._queued_rows + n > self.queue_rows:
+                self._cond.wait(0.05)
+                if self._closed:
+                    raise RuntimeError("ServingFront is closed")
+            self._queue.append(_FrontRequest(features, fut, n,
+                                             time.perf_counter()))
+            self._queued_rows += n
+            self.stats["requests"] += 1
+            self.stats["rows"] += n
+            if self._queued_rows > self.stats["queue_peak_rows"]:
+                self.stats["queue_peak_rows"] = self._queued_rows
+            self._cond.notify_all()
+        telemetry.count("serve/front_requests")
+        telemetry.count("serve/front_rows", n)
+        return fut
+
+    def predict(self, features: np.ndarray,
+                timeout: Optional[float] = None) -> np.ndarray:
+        """Synchronous convenience: ``submit(...).result(timeout)``."""
+        return self.submit(features).result(timeout)
+
+    # ------------------------------------------------------------ hot swap
+
+    def swap_engine(self, new_engine: ServingEngine, warmup: bool = True,
+                    timeout: Optional[float] = None) -> float:
+        """Drain-and-flip atomic hot swap (axis c).  Warms the new
+        engine's bucket programs FIRST (double buffering: the old engine
+        keeps serving during the compile), then appends a swap marker to
+        the request queue and blocks until the worker drains to it and
+        flips.  Returns the drain time in seconds (marker enqueue →
+        flip), recorded as ``serve/swap_drain_us``."""
+        if warmup:
+            new_engine.warmup()
+        marker = _SwapMarker(new_engine, threading.Event(),
+                             time.perf_counter())
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("ServingFront is closed")
+            self._queue.append(marker)
+            self._cond.notify_all()
+        if not marker.event.wait(timeout):
+            # a timed-out swap must not flip LATER behind the caller's
+            # back: withdraw the marker if the worker has not reached it
+            # yet; if it is already gone the flip is committed (the
+            # worker sets the event right after popping) — wait it out
+            # and report the swap normally
+            with self._cond:
+                try:
+                    self._queue.remove(marker)
+                    withdrawn = True
+                except ValueError:
+                    withdrawn = False
+            if withdrawn:
+                raise TimeoutError("hot-swap drain timed out (swap "
+                                   "withdrawn; the old engine still "
+                                   "serves)")
+            marker.event.wait(60.0)
+        drain = time.perf_counter() - marker.t0
+        self.stats["swaps"] += 1
+        self.stats["last_swap_drain_s"] = drain
+        telemetry.count("serve/swaps")
+        telemetry.count("serve/swap_drain_us", int(drain * 1e6))
+        return drain
+
+    # ------------------------------------------------------------ lifecycle
+
+    def close(self, timeout: float = 60.0) -> None:
+        """Stop accepting, drain EVERY queued request (zero-drop also at
+        shutdown), join the worker, and file the queue-peak gauge."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self._thread.join(timeout)
+        telemetry.count("serve/queue_peak_rows",
+                        self.stats["queue_peak_rows"])
+
+    def __enter__(self) -> "ServingFront":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+    # ------------------------------------------------------------- worker
+
+    def _rows_before_marker(self, cap: int) -> int:
+        """Rows queued ahead of the first swap marker, scanning at most
+        until ``cap`` is reached — the caller only compares against the
+        top bucket, and a full bounded queue can hold ~queue_rows
+        1-row requests (an uncapped scan under the lock would stall
+        every submit on each linger poll)."""
+        rows = 0
+        for item in self._queue:
+            if isinstance(item, _SwapMarker) or rows >= cap:
+                break
+            rows += item.rows
+        return rows
+
+    def _serve_loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._queue and not self._closed:
+                    self._cond.wait(0.1)
+                if not self._queue:
+                    break                      # closed and drained
+                head = self._queue[0]
+                if isinstance(head, _SwapMarker):
+                    # the flip: everything ahead has been scored on the
+                    # old engine; everything behind scores on the new one
+                    self._queue.popleft()
+                    self._engine = head.engine
+                    head.event.set()
+                    continue
+                maxb = self._engine.buckets[-1]
+                deadline = head.t_submit + self.linger_s
+                while not self._closed:
+                    if self._rows_before_marker(maxb) >= maxb:
+                        break
+                    remaining = deadline - time.perf_counter()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(min(remaining, 0.05))
+                batch: "List[_FrontRequest]" = []
+                total = 0
+                while self._queue and not isinstance(self._queue[0],
+                                                     _SwapMarker):
+                    r = self._queue[0]
+                    if batch and total + r.rows > maxb:
+                        break                  # next batch picks it up
+                    self._queue.popleft()
+                    batch.append(r)
+                    total += r.rows
+                self._queued_rows -= total
+                depth_after = self._queued_rows
+                engine = self._engine
+                self._cond.notify_all()        # wake blocked submitters
+            # device work runs OUTSIDE the lock: submit stays wait-free
+            # while a batch is on device
+            wait_s = time.perf_counter() - batch[0].t_submit
+            self.stats["batches"] += 1
+            self.stats["coalesced_rows"] += total
+            self.stats["linger_wait_s"] += wait_s
+            telemetry.count("serve/coalesced_batches")
+            telemetry.count("serve/coalesced_rows", total)
+            telemetry.count("serve/coalesced_requests", len(batch))
+            telemetry.count("serve/linger_wait_us", int(wait_s * 1e6))
+            telemetry.count("serve/queue_depth_rows", total + depth_after)
+            telemetry.count("serve/queue_depth_samples")
+            feats = (batch[0].features if len(batch) == 1 else
+                     np.concatenate([r.features for r in batch], axis=0))
+            try:
+                scores = engine.scores(feats)
+            except BaseException as e:  # surfaced per request, never lost
+                for r in batch:
+                    if not (r.future.cancelled() or r.future.done()):
+                        r.future.set_exception(e)
+                continue
+            ofs = 0
+            for r in batch:
+                # per-request delivery: one client cancelling its Future
+                # in the check→set window must not poison the OTHER
+                # requests of the same coalesced batch
+                try:
+                    if not r.future.cancelled():
+                        r.future.set_result(scores[:, ofs:ofs + r.rows])
+                except Exception:
+                    pass
+                ofs += r.rows
 
 
 def engine_options_from_config(io_config) -> dict:
     """The IOConfig → ServingEngine option mapping, single-homed (cli.py
-    and Predictor both consult it)."""
+    and Predictor both consult it).  ``serve_shards`` /
+    ``predict_linger_us`` / ``predict_queue`` (ISSUE 13) ride beside the
+    PR 7 knobs — the engine validates them loudly at construction."""
     return {
         "buckets": io_config.predict_bucket_list(),
         "quantize": io_config.predict_quantize,
         "donate": io_config.predict_donate,
         "algo": io_config.predict_algo,
+        "shards": io_config.serve_shards,
+        "linger_us": io_config.predict_linger_us,
+        "queue": io_config.predict_queue,
     }

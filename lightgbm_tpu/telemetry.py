@@ -1008,6 +1008,26 @@ def span(name: str):
 # "predict" (span of the same name wraps the device walk;
 # "predict_encode" times the host rank-encode), so the roofline and
 # compile blocks attribute serving alongside training.
+#
+# Distributed elastic serving (ISSUE 13) extends the family:
+# ``serve/front_requests`` / ``serve/front_rows`` = ServingFront intake;
+# ``serve/coalesced_batches`` / ``serve/coalesced_rows`` /
+# ``serve/coalesced_requests`` = the cross-request batching outcome (the
+# coalesced batch SIZE histogram is the engine's existing
+# ``serve/bucket_<B>`` counters — each coalesced batch lands on exactly
+# one ladder bucket); ``serve/linger_wait_us`` = cumulative
+# first-arrival→dispatch wait (mean = /coalesced_batches);
+# ``serve/queue_depth_rows`` + ``serve/queue_depth_samples`` = queue
+# depth sampled at each batch formation (mean = rows/samples) with
+# ``serve/queue_peak_rows`` filed once at front close; ``serve/swaps`` /
+# ``serve/swap_drain_us`` = hot-swap count and drain-and-flip latency;
+# ``serve/warmups`` = double-buffered engine warmups (the compile the
+# swap keeps OUT of the request path).  The tree-sharded engine's
+# cross-shard exchange files wire-metrics sites ``serve/tree_carry``
+# (the [C, N] carry-chain ppermute hops, shards-1 per trace) and
+# ``serve/tree_psum`` (the final masked broadcast psum), so the
+# interconnect block prices tree_psum wire bytes per phase beside the
+# training seams — and graftlint J2's census covers the same two sites.
 
 def count(name: str, n: int = 1) -> None:
     """Bump a monotonic counter (kernel-route decisions, env-var trips,

@@ -11,6 +11,13 @@ round against the earlier trajectory:
   ``spread``/``parity_spread``-style (max-min)/median markers bench.py
   records for exactly this purpose (sigma = band/2; flagged beyond
   ``--sigma-mult`` sigmas, default 3);
+- **serving latency + zero-tolerance contracts** (ISSUE 13): the
+  ``bench_serve`` lane's ``serve_p99_us`` must not GROW beyond the wide
+  observability band (LATENCY_KEYS), and ``predict_recompiles`` /
+  ``serve_recompiles`` / ``serve_dropped`` / ``serve_misscored`` are
+  ABSOLUTE findings — any nonzero on the latest round fails the gate
+  with no trajectory at all (the closed-program-ladder and
+  zero-drop-hot-swap contracts);
 - **attained fraction**: the roofline block's ``frac_of_peak_flops`` /
   ``frac_of_peak_bw`` per phase, when present — a throughput number can
   hide a kernel regression behind a faster host, the attained fraction
@@ -91,12 +98,47 @@ RATE_KEYS: Tuple[Tuple[str, str], ...] = (
     # (ingest_rss_ok false would be a correctness bug, not a trajectory
     # drift — the bench lane itself surfaces it).
     ("ingest_rows_per_sec", "ingest_spread"),
+    # elastic serving (ISSUE 13, bench.py --bench-serve): sustained
+    # rows/sec through the coalescing ServingFront under the open-loop
+    # load generator.  The p99 lane rides LATENCY_KEYS (must-not-grow);
+    # recompiles/dropped/misscored are absolute findings below.
+    ("serve_rows_per_sec", "serve_spread"),
+)
+
+# lower-is-better keys gated in the GROW direction (ISSUE 13): the p99
+# under open-loop load.  Latency tails on a shared host swing far more
+# than throughput medians, so the band floor is the wide observability
+# floor (like the multichip skew series): the lane catches
+# order-of-magnitude breaks — a lost coalescing path, a swap stall in
+# the request path — not percent drift.
+LATENCY_KEYS: Tuple[Tuple[str, str], ...] = (
+    ("serve_p99_us", "serve_spread"),
+)
+
+# absolute zero-tolerance keys (no trajectory needed): any nonzero on
+# the LATEST round is a finding.  predict/serve recompiles break the
+# closed-program-ladder contract; dropped/misscored requests break the
+# hot-swap zero-drop contract (ISSUE 13).
+ABSOLUTE_ZERO_KEYS: Tuple[Tuple[str, str], ...] = (
+    ("predict_recompiles",
+     "serving engine recompiled at a bucketed batch shape (the "
+     "compiled-program ladder is no longer closed)"),
+    ("serve_recompiles",
+     "elastic-serving lane recompiled at a coalesced batch shape (the "
+     "compiled-program ladder is no longer closed under load)"),
+    ("serve_dropped",
+     "request(s) dropped across the mid-load hot swap — the "
+     "drain-and-flip zero-drop contract is broken"),
+    ("serve_misscored",
+     "request(s) misscored across the mid-load hot swap (a result "
+     "matched neither the old nor the new engine — a torn swap)"),
 )
 
 DEFAULT_FLOOR = 0.02      # minimum relative noise band when none recorded
 DEFAULT_SIGMA_MULT = 3.0
 # noise-band floor for the multichip skew/interconnect series (no
-# recorded spread; tiny smoke runs -> timing-noise-dominated)
+# recorded spread; tiny smoke runs -> timing-noise-dominated) — also the
+# LATENCY_KEYS floor, for the same reason
 _OBS_FLOOR = 0.5
 
 
@@ -222,19 +264,18 @@ def _check_group(metric: str, entries: List[dict], floor: float,
             f"{metric}: trajectory mixes device kinds {sorted(kinds)} — "
             "cross-hardware comparisons refused "
             "(--allow-cross-hardware to override)")
-    # serving no-recompile contract (ISSUE 7): a nonzero
-    # predict_recompiles means the bucket ladder stopped being a closed
-    # program set — an absolute red flag, no trajectory needed
-    recompiles = entries[-1]["rec"].get("predict_recompiles")
-    if isinstance(recompiles, (int, float)) and recompiles > 0:
-        findings.append({
-            "metric": metric, "key": "predict_recompiles",
-            "latest_round": entries[-1]["round"],
-            "latest": recompiles, "baseline": 0,
-            "detail": "serving engine recompiled at a bucketed batch "
-                      "shape (the compiled-program ladder is no longer "
-                      "closed)",
-        })
+    # absolute zero-tolerance contracts (ISSUE 7 no-recompile, ISSUE 13
+    # zero-drop hot swap): any nonzero on the latest round is a finding,
+    # no trajectory needed
+    for akey, detail in ABSOLUTE_ZERO_KEYS:
+        v = entries[-1]["rec"].get(akey)
+        if isinstance(v, (int, float)) and v > 0:
+            findings.append({
+                "metric": metric, "key": akey,
+                "latest_round": entries[-1]["round"],
+                "latest": v, "baseline": 0,
+                "detail": detail,
+            })
     _check_mixedbin_resolution(metric, entries[-1], findings)
     if len(entries) < 2:
         return
@@ -261,6 +302,29 @@ def _check_group(metric: str, entries: List[dict], floor: float,
                 "latest_round": latest_round,
                 "latest": latest, "baseline": round(baseline, 6),
                 "drop": round(1.0 - latest / baseline, 4),
+                "allowed_drop": round(sigma_mult * sigma, 4),
+            })
+    # lower-is-better latency lanes (ISSUE 13): must not GROW beyond
+    # the wide observability band — p99 tails are timing-noise-dominated
+    # on shared hosts, so this catches order-of-magnitude breaks
+    for key, spread_key in LATENCY_KEYS:
+        series = _series(entries, key)
+        if len(series) < 2 or series[-1][0] != latest_round:
+            continue
+        prior = [v for r, v in series[:-1]]
+        latest = series[-1][1]
+        baseline = _median(prior)
+        if baseline <= 0:
+            continue
+        band = max(_noise_band(entries[:-1], spread_key, floor),
+                   _OBS_FLOOR)
+        sigma = band / 2.0
+        if latest > baseline * (1.0 + sigma_mult * sigma):
+            findings.append({
+                "metric": metric, "key": key,
+                "latest_round": latest_round,
+                "latest": latest, "baseline": round(baseline, 6),
+                "drop": round(latest / baseline - 1.0, 4),
                 "allowed_drop": round(sigma_mult * sigma, 4),
             })
 

@@ -229,6 +229,42 @@ def _ingest_lines(counters, summary_phase_times):
     return out
 
 
+def _serve_lines(counters):
+    """The ``serve/*`` counter family (ISSUE 7 engine + ISSUE 13 front)
+    with derived coalescing/linger/queue means.  The coalesced batch
+    SIZE histogram is the ``serve/bucket_<B>`` rows; the tree-sharded
+    wire bytes ride the interconnect block (sites ``serve/tree_*``)."""
+    out = ["Serving (serve/*)", "-----------------"]
+    fam = {k: v for k, v in counters.items() if k.startswith("serve/")}
+    if not fam:
+        out.append("(no serve counters — no engine/front activity while "
+                   "telemetry was armed)")
+        return out
+    width = max(len(k) for k in fam)
+    for k, v in sorted(fam.items()):
+        out.append(f"{k.ljust(width)}  {v}")
+    batches = fam.get("serve/coalesced_batches", 0)
+    if batches:
+        out.append("mean coalesced batch  %.1f rows over %.1f requests"
+                   % (fam.get("serve/coalesced_rows", 0) / batches,
+                      fam.get("serve/coalesced_requests", 0) / batches))
+        out.append("mean linger wait      %.0f us"
+                   % (fam.get("serve/linger_wait_us", 0) / batches))
+    samples = fam.get("serve/queue_depth_samples", 0)
+    if samples:
+        # queue_peak_rows is a cumulative counter each front's close()
+        # adds its own peak into — a SUM across fronts, not a job peak
+        out.append("mean queue depth      %.1f rows "
+                   "(per-front peaks summed: %d)"
+                   % (fam.get("serve/queue_depth_rows", 0) / samples,
+                      fam.get("serve/queue_peak_rows", 0)))
+    swaps = fam.get("serve/swaps", 0)
+    if swaps:
+        out.append("mean swap drain       %.0f us over %d swap(s)"
+                   % (fam.get("serve/swap_drain_us", 0) / swaps, swaps))
+    return out
+
+
 def _compile_lines(comp):
     out = ["Compile observability", "---------------------"]
     if not comp:
@@ -367,6 +403,8 @@ def report(path: str, as_json: bool = False) -> int:
             out.append(f"  {k.ljust(width)}  {val:>12}")
     out.append("")
     out += _ingest_lines(counters, (summary or {}).get("phase_times"))
+    out.append("")
+    out += _serve_lines(counters)
     out.append("")
     out += _roofline_lines(roofline)
     out.append("")

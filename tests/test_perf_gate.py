@@ -109,6 +109,52 @@ def test_serving_recompiles_flagged_absolutely(tmp_path):
                for f in report["findings"])
 
 
+def test_serve_zero_drop_contract_flagged_absolutely(tmp_path):
+    """ISSUE 13: a single dropped or misscored request across the
+    mid-load hot swap — or a serve-lane recompile — fails the gate with
+    no trajectory needed."""
+    for key in ("serve_recompiles", "serve_dropped", "serve_misscored"):
+        d = tmp_path / key
+        d.mkdir()
+        path = _write_round(d, 7, 2.0e5, metric="serve_4k",
+                            extra={key: 1})
+        report = perf_gate.check_files([path])
+        assert any(f["key"] == key for f in report["findings"]), key
+        clean = _write_round(d, 8, 2.0e5, metric="serve_4k",
+                             extra={key: 0})
+        assert perf_gate.check_files([clean])["findings"] == []
+
+
+def test_serve_p99_growth_flagged_and_rate_gated(tmp_path):
+    """The serve lanes join the trajectory: serve_rows_per_sec gates in
+    the DROP direction like every rate key, serve_p99_us in the GROW
+    direction under the wide latency band (floor 0.5 -> 75% allowed
+    growth at 3 sigma: order-of-magnitude breaks, not percent drift)."""
+    def extra(rps, p99):
+        return {"serve_rows_per_sec": rps, "serve_spread": 0.02,
+                "serve_p99_us": p99}
+
+    paths = [_write_round(tmp_path, i + 1, 1.67, metric="serve_4k",
+                          extra=extra(rps, p99))
+             for i, (rps, p99) in enumerate(
+                 [(2.0e5, 5000.0), (2.01e5, 5200.0), (1.99e5, 4900.0),
+                  (2.0e5, 25000.0)])]     # p99 5x the prior median
+    report = perf_gate.check_files(paths)
+    keys = [f["key"] for f in report["findings"]]
+    assert "serve_p99_us" in keys
+    # within-band p99 wobble passes
+    ok = _write_round(tmp_path, 5, 1.67, metric="serve_4k",
+                      extra=extra(2.0e5, 6000.0))
+    report = perf_gate.check_files(paths[:-1] + [ok])
+    assert report["findings"] == []
+    # a serve throughput collapse is a rate finding
+    slow = _write_round(tmp_path, 6, 1.67, metric="serve_4k",
+                        extra=extra(0.8e5, 5000.0))
+    report = perf_gate.check_files(paths[:-1] + [slow])
+    assert any(f["key"] == "serve_rows_per_sec"
+               for f in report["findings"])
+
+
 def test_mixedbin_resolution_flagged_absolutely(tmp_path):
     """ISSUE 12: a hybrid/voting round that requested mixed_bin
     auto/true on a mixed table but resolved the uniform layout is an

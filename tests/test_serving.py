@@ -289,9 +289,18 @@ def test_serving_config_options():
     assert cfg.io_config.predict_bucket_list() == (8, 64)
     opts = engine_options_from_config(cfg.io_config)
     assert opts == {"buckets": (8, 64), "quantize": "int8",
-                    "donate": "false", "algo": "scan"}
+                    "donate": "false", "algo": "scan",
+                    "shards": 0, "linger_us": 200, "queue": 4}
+    cfg2 = OverallConfig()
+    cfg2.set({"serve_shards": "2", "predict_linger_us": "1000",
+              "predict_queue": "8"}, require_data=False)
+    opts2 = engine_options_from_config(cfg2.io_config)
+    assert (opts2["shards"], opts2["linger_us"], opts2["queue"]) \
+        == (2, 1000, 8)
     for bad in ({"predict_quantize": "int4"}, {"predict_algo": "dfs"},
                 {"predict_donate": "maybe"}, {"predict_buckets": "0,4"},
-                {"predict_buckets": "a,b"}):
+                {"predict_buckets": "a,b"}, {"serve_shards": "-1"},
+                {"predict_linger_us": "-5"}, {"predict_queue": "0"},
+                {"serve_shards": "2", "predict_algo": "scan"}):
         with pytest.raises(LightGBMError):
             OverallConfig().set(dict(bad), require_data=False)

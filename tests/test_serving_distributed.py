@@ -1,0 +1,429 @@
+"""Distributed elastic serving (ISSUE 13, lightgbm_tpu/serving.py).
+
+Correctness bars, in the ISSUE's order:
+
+(a) the tree-sharded engine scores BIT-EQUAL to the single-device
+    engine — f32 AND int8, all four objectives, dividing and
+    non-dividing shard counts — on the virtual-device mesh, with each
+    device holding only its tree block;
+(b) the cross-request coalescing front returns results bit-identical to
+    scoring each request alone (rows are independent through the walk),
+    under the bucket ladder and the linger deadline;
+(c) the drain-and-flip hot swap drops and misscores ZERO requests
+    mid-load: every result matches the old or the new engine exactly,
+    and the queue-order flip point is atomic;
+(d) streamed ``predict_file`` writes a BYTE-IDENTICAL result file at
+    any chunk length (out-of-core scoring == resident scoring).
+
+Heavy load-generator cells ride the slow lane.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import costmodel, telemetry
+from lightgbm_tpu.io.dataset import Dataset
+from lightgbm_tpu.models.predictor import Predictor
+from lightgbm_tpu.serving import ServingEngine, ServingFront
+from lightgbm_tpu.utils.log import LightGBMError
+
+BASE = {"num_leaves": 15, "min_data_in_leaf": 20,
+        "min_sum_hessian_in_leaf": 1.0, "num_iterations": 8,
+        "learning_rate": 0.2}
+
+OBJECTIVES = ("regression", "binary", "lambdarank", "multiclass")
+
+_CASES = {}
+
+
+def _case(objective, n=500, f=6, seed=3):
+    """(trained booster, features), cached per objective — the sharded
+    equivalence matrix reuses one model per objective."""
+    key = (objective, n, f, seed)
+    if key in _CASES:
+        return _CASES[key]
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, f)
+    params = dict(BASE, objective=objective)
+    ds_kwargs = {}
+    if objective == "regression":
+        y = (x[:, 0] + 0.3 * x[:, 1] ** 2
+             + 0.1 * rng.randn(n)).astype(np.float32)
+    elif objective == "binary":
+        y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(np.float32)
+    elif objective == "lambdarank":
+        y = np.clip(np.digitize(x[:, 0], [-0.6, 0.2, 1.0]),
+                    0, 3).astype(np.float32)
+        ds_kwargs["query_boundaries"] = np.arange(0, n + 1, 50)
+    else:
+        y = np.digitize(x[:, 0], [-0.5, 0.5]).astype(np.float32)
+        params["num_class"] = 3
+        params["num_iterations"] = 4
+    ds = Dataset.from_arrays(x, y, max_bin=64, **ds_kwargs)
+    _CASES[key] = (lgb.train(params, ds), x)
+    return _CASES[key]
+
+
+# ========================== (a) tree-sharded bit-equality on the mesh
+
+
+@pytest.mark.parametrize("objective", OBJECTIVES)
+@pytest.mark.parametrize("quantize", ["float32", "int8"])
+def test_sharded_bit_equal_to_single_device(objective, quantize):
+    """shards=2: every (objective, precision) cell scores bit-equal —
+    the canonical-order carry chain reproduces the single-device f32
+    add sequence exactly (ops/scoring.py sharding block comment)."""
+    booster, x = _case(objective)
+    flat = booster.export_flat()
+    base = ServingEngine(flat, quantize=quantize).scores(x)
+    sharded = ServingEngine(flat, quantize=quantize, shards=2).scores(x)
+    np.testing.assert_array_equal(base, sharded)
+
+
+@pytest.mark.parametrize("shards", [3, 4])
+def test_sharded_bit_equal_nondividing_and_wider(shards):
+    """Non-dividing tree counts pad with inert stumps that are MASKED
+    out of the accumulate (never added, not even as zeros) — bit
+    equality holds at any shard count the mesh can host."""
+    booster, x = _case("binary")
+    flat = booster.export_flat()
+    base = ServingEngine(flat).scores(x)
+    np.testing.assert_array_equal(
+        base, ServingEngine(flat, shards=shards).scores(x))
+    b8 = ServingEngine(flat, quantize="int8").scores(x)
+    np.testing.assert_array_equal(
+        b8, ServingEngine(flat, quantize="int8", shards=shards).scores(x))
+
+
+def test_sharded_leaf_indices_match():
+    booster, x = _case("binary")
+    flat = booster.export_flat()
+    np.testing.assert_array_equal(
+        ServingEngine(flat).leaf_indices(x),
+        ServingEngine(flat, shards=2).leaf_indices(x))
+
+
+def test_sharded_tables_live_on_their_shards():
+    """The HBM contract behind the multi-GB-ensemble claim: each mesh
+    device holds ONLY its contiguous tree block of the node tables."""
+    booster, x = _case("binary")
+    flat = booster.export_flat()
+    eng = ServingEngine(flat, shards=2)
+    eng.scores(x[:8])
+    t = eng._device_tables()
+    T_pad = flat.num_trees + (-flat.num_trees) % 2
+    shards = t["sf"].addressable_shards
+    assert len(shards) == 2
+    assert all(s.data.shape == (T_pad // 2, flat.max_nodes)
+               for s in shards)
+    devices = {s.device for s in shards}
+    assert len(devices) == 2
+
+
+def test_sharded_rejects_oversubscribed_mesh():
+    """serve_shards beyond the device count fails at ENGINE CONSTRUCTION
+    (loudly — never a silent shrink that would change the shard layout
+    mid-deployment)."""
+    booster, _ = _case("binary")
+    flat = booster.export_flat()
+    with pytest.raises(LightGBMError):
+        ServingEngine(flat, shards=4096)
+
+
+def test_sharded_rejects_scan_algo():
+    booster, _ = _case("binary")
+    with pytest.raises(ValueError):
+        ServingEngine(booster.export_flat(), shards=2, algo="scan")
+
+
+def test_sharded_no_recompile_on_repeated_bucketed_calls():
+    """The closed-program contract survives sharding: repeated bucketed
+    calls on the sharded engine bump calls on existing programs and
+    never add a signature."""
+    booster, x = _case("binary")
+    telemetry.enable()
+    telemetry.reset()
+    try:
+        eng = ServingEngine(booster.export_flat(), buckets=(1, 32, 1024),
+                            shards=2)
+        for n in (5, 9, 31):
+            eng.scores(x[:n])
+        progs = costmodel.phase_program_records("predict")
+        n_programs = len(progs)
+        assert n_programs >= 1
+        for n in (6, 17, 32, 2, 30):
+            eng.scores(x[:n])
+        assert len(costmodel.phase_program_records("predict")) \
+            == n_programs, "sharded bucketed repeat calls recompiled"
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+
+
+def test_warmup_precompiles_every_bucket():
+    """warmup() (the hot-swap double-buffer step) compiles the whole
+    bucket ladder: serving afterwards adds zero program signatures."""
+    booster, x = _case("binary")
+    telemetry.enable()
+    telemetry.reset()
+    try:
+        eng = ServingEngine(booster.export_flat(), buckets=(1, 32, 1024))
+        eng.warmup()
+        n_programs = len(costmodel.phase_program_records("predict"))
+        for n in (1, 7, 31, 33, 1000):
+            eng.scores(x[:n])
+        assert len(costmodel.phase_program_records("predict")) \
+            == n_programs, "warmup left a bucket uncompiled"
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+
+
+# =============================== (b) cross-request coalescing front
+
+
+def test_front_results_bit_equal_to_individual_scoring():
+    """Coalescing never changes a bit: every request's Future resolves
+    to exactly the slice the engine returns for that request alone."""
+    booster, x = _case("binary")
+    flat = booster.export_flat()
+    base = ServingEngine(flat).scores(x)
+    front = ServingFront(ServingEngine(flat), linger_us=5000)
+    try:
+        futs = [(s, n, front.submit(x[s:s + n]))
+                for s, n in ((0, 50), (50, 1), (51, 200), (251, 37),
+                             (288, 212))]
+        for s, n, fut in futs:
+            np.testing.assert_array_equal(fut.result(30),
+                                          base[:, s:s + n])
+        assert front.stats["requests"] == 5
+        assert front.stats["rows"] == 500
+        assert 1 <= front.stats["batches"] <= 5
+    finally:
+        front.close()
+
+
+def test_front_coalesces_under_linger():
+    """With a generous linger and the worker pinned behind a first
+    request, later submissions join ONE batch (the coalesced-batch
+    stats prove cross-request packing actually happened)."""
+    booster, x = _case("binary")
+    front = ServingFront(ServingEngine(booster.export_flat()),
+                         linger_us=200_000)
+    try:
+        futs = [front.submit(x[i * 20:(i + 1) * 20]) for i in range(10)]
+        for fut in futs:
+            fut.result(30)
+        # all 10 landed within one linger window -> far fewer batches
+        assert front.stats["batches"] < 10
+        assert front.stats["coalesced_rows"] == 200
+    finally:
+        front.close()
+
+
+def test_front_linger_zero_dispatches_immediately():
+    booster, x = _case("binary")
+    front = ServingFront(ServingEngine(booster.export_flat()),
+                         linger_us=0)
+    try:
+        t0 = time.perf_counter()
+        np.testing.assert_array_equal(
+            front.predict(x[:4], timeout=30),
+            ServingEngine(booster.export_flat()).scores(x[:4]))
+        assert time.perf_counter() - t0 < 5.0
+    finally:
+        front.close()
+
+
+def test_front_close_drains_queue_and_rejects_new_work():
+    """Zero-drop also at shutdown: everything queued before close()
+    resolves; submit afterwards raises."""
+    booster, x = _case("binary")
+    flat = booster.export_flat()
+    base = ServingEngine(flat).scores(x)
+    front = ServingFront(ServingEngine(flat), linger_us=100_000)
+    futs = [front.submit(x[i * 10:(i + 1) * 10]) for i in range(8)]
+    front.close()
+    for i, fut in enumerate(futs):
+        np.testing.assert_array_equal(fut.result(1),
+                                      base[:, i * 10:(i + 1) * 10])
+    with pytest.raises(RuntimeError):
+        front.submit(x[:4])
+
+
+# ===================================== (c) zero-drop hot swap mid-load
+
+
+def _swap_refs():
+    """Two engines over the SAME booster at different tree prefixes —
+    the continued-training swap pair, with provably different scores."""
+    booster, x = _case("binary")
+    flat_a = booster.export_flat(len(booster.models) - 2)
+    flat_b = booster.export_flat()
+    eng_a, eng_b = ServingEngine(flat_a), ServingEngine(flat_b)
+    ref_a, ref_b = eng_a.scores(x), eng_b.scores(x)
+    assert not np.array_equal(ref_a, ref_b)
+    return x, eng_a, eng_b, ref_a, ref_b
+
+
+def test_hot_swap_mid_load_zero_drop():
+    """The axis-c contract: concurrent submitters keep firing while
+    swap_engine drains and flips.  Every request resolves, every result
+    equals the OLD or the NEW engine exactly (no torn scores), and
+    everything submitted after the swap returns is new-engine."""
+    x, eng_a, eng_b, ref_a, ref_b = _swap_refs()
+    front = ServingFront(eng_a, linger_us=500)
+    results = []
+    stop = threading.Event()
+
+    def load():
+        i = 0
+        while not stop.is_set():
+            s = (i * 20) % 480
+            results.append((s, 20, front.submit(x[s:s + 20])))
+            i += 1
+            time.sleep(0.001)
+
+    threads = [threading.Thread(target=load) for _ in range(2)]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(0.2)
+        drain = front.swap_engine(eng_b)          # warms, drains, flips
+        assert drain >= 0.0
+        assert front.stats["swaps"] == 1
+        # post-swap requests MUST score on the new engine
+        post = [(s, front.submit(x[s:s + 20])) for s in (0, 100, 460)]
+        time.sleep(0.2)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(30)
+        front.close()
+    assert len(results) > 20
+    dropped = misscored = 0
+    for s, n, fut in results:
+        if not fut.done() or fut.exception() is not None:
+            dropped += 1
+            continue
+        got = np.asarray(fut.result())
+        if not (np.array_equal(got, ref_a[:, s:s + n])
+                or np.array_equal(got, ref_b[:, s:s + n])):
+            misscored += 1
+    assert dropped == 0, f"{dropped} requests dropped across the swap"
+    assert misscored == 0, f"{misscored} requests torn across the swap"
+    for s, fut in post:
+        np.testing.assert_array_equal(np.asarray(fut.result(30)),
+                                      ref_b[:, s:s + 20])
+
+
+def test_swap_flip_is_atomic_in_queue_order():
+    """Requests queued BEHIND the swap marker (while the worker is
+    stalled on the pre-swap batch) score on the new engine — the flip
+    point is a queue position, not a wall-clock race."""
+    x, eng_a, eng_b, ref_a, ref_b = _swap_refs()
+    front = ServingFront(eng_a, linger_us=300_000)   # pin the worker
+    try:
+        pre = front.submit(x[:30])
+        swap_done = {}
+        t = threading.Thread(target=lambda: swap_done.__setitem__(
+            "drain", front.swap_engine(eng_b, timeout=60)))
+        t.start()
+        while front.stats["swaps"] == 0 and t.is_alive():
+            time.sleep(0.01)
+        t.join(60)
+        post = front.submit(x[30:60])
+        np.testing.assert_array_equal(np.asarray(pre.result(60)),
+                                      ref_a[:, :30])
+        np.testing.assert_array_equal(np.asarray(post.result(60)),
+                                      ref_b[:, 30:60])
+        assert swap_done["drain"] >= 0.0
+    finally:
+        front.close()
+
+
+@pytest.mark.slow
+def test_hot_swap_under_sustained_open_loop_load():
+    """The heavy cell: a sustained multi-second open-loop load (sharded
+    old engine -> single-device new engine) with a mid-load swap — the
+    bench_serve contract at test scale.  Slow lane by design."""
+    booster, x = _case("binary")
+    flat = booster.export_flat()
+    eng_a = ServingEngine(flat, shards=2, linger_us=1000)
+    eng_b = ServingEngine(flat, quantize="int8")
+    ref_a = ServingEngine(flat).scores(x)          # sharded == single
+    ref_b = ServingEngine(flat, quantize="int8").scores(x)
+    front = ServingFront(eng_a)
+    records = []
+    try:
+        t0 = time.perf_counter()
+        swapped = False
+        i = 0
+        while time.perf_counter() - t0 < 4.0:
+            if not swapped and time.perf_counter() - t0 > 2.0:
+                front.swap_engine(eng_b)
+                swapped = True
+            s = (i * 16) % 480
+            records.append((s, front.submit(x[s:s + 16])))
+            i += 1
+            time.sleep(0.002)
+    finally:
+        front.close()
+    assert len(records) > 100
+    for s, fut in records:
+        assert fut.done() and fut.exception() is None
+        got = np.asarray(fut.result())
+        assert (np.array_equal(got, ref_a[:, s:s + 16])
+                or np.array_equal(got, ref_b[:, s:s + 16]))
+
+
+# ============================= (d) streamed out-of-core predict_file
+
+
+def _write_tsv(tmp_path, x, name="pred.tsv"):
+    data = tmp_path / name
+    np.savetxt(data, np.column_stack([np.zeros(len(x)), x]),
+               delimiter="\t", fmt="%.8f")
+    return data
+
+
+@pytest.mark.parametrize("objective", ["binary", "multiclass"])
+def test_streamed_predict_file_byte_equal_to_resident(tmp_path, objective):
+    """predict_file at ANY chunk length writes byte-identical output:
+    the streamed parse->encode->score pipeline composes with the engine
+    without moving a single result bit (rows are independent through
+    bucket padding and the per-row output format)."""
+    booster, x = _case(objective)
+    data = _write_tsv(tmp_path, x)
+    predictor = Predictor(booster, True, False, -1)
+    out_resident = tmp_path / "resident.txt"
+    out_streamed = tmp_path / "streamed.txt"
+    predictor.predict_file(str(data), str(out_resident),
+                           has_header=False, chunk_lines=10 ** 6)
+    predictor.predict_file(str(data), str(out_streamed),
+                           has_header=False, chunk_lines=33)
+    assert out_streamed.read_bytes() == out_resident.read_bytes()
+    assert out_streamed.stat().st_size > 0
+
+
+def test_streamed_predict_file_sharded_engine(tmp_path):
+    """The composed configuration: out-of-core chunking THROUGH the
+    tree-sharded engine — still byte-identical to the single-device
+    resident pass, and still one ensemble flatten for the whole file."""
+    from lightgbm_tpu import serving
+    booster, x = _case("binary")
+    data = _write_tsv(tmp_path, x)
+    base = tmp_path / "base.txt"
+    Predictor(booster, True, False, -1).predict_file(
+        str(data), str(base), has_header=False, chunk_lines=10 ** 6)
+    count0 = serving.FLATTEN_COUNT
+    sharded = tmp_path / "sharded.txt"
+    p = Predictor(booster, True, False, -1,
+                  serving_options={"shards": 2, "queue": 3})
+    p.predict_file(str(data), str(sharded), has_header=False,
+                   chunk_lines=41)
+    assert serving.FLATTEN_COUNT == count0 + 1
+    assert sharded.read_bytes() == base.read_bytes()
