@@ -700,6 +700,142 @@ def bench_ingest(args) -> int:
     return 0
 
 
+# keys the headline bench copies out of the --bench-ckpt subprocess
+# (scripts/perf_gate.py: ckpt_overhead_pct rides the must-not-grow
+# latency lane; ckpt_restore_exact recorded False on ANY round is an
+# ABSOLUTE finding — the bit-identical same-topology restore contract)
+CKPT_COPY_KEYS = (
+    "ckpt_overhead_pct", "ckpt_spread", "ckpt_restore_exact",
+    "ckpt_writes", "ckpt_dropped", "ckpt_interval",
+    "ckpt_off_iters_per_sec", "ckpt_on_iters_per_sec",
+)
+
+
+def bench_ckpt(args) -> int:
+    """Checkpoint-cost lane (ISSUE 14): price asynchronous periodic
+    checkpointing against the identical run with it off, and pin the
+    restore contract.
+
+    Two numbers: ``ckpt_overhead_pct`` — the median percent slowdown of
+    ``run_training`` with ``checkpoint_interval=1`` (every iteration, the
+    worst case; the async writer thread serializes + writes off the hot
+    loop, so this prices exactly the snapshot cost the loop cannot hide)
+    — and ``ckpt_restore_exact`` — True iff a kill-free
+    train→checkpoint→fresh-booster-restore→finish run reproduces the
+    uninterrupted run's model text AND scores bitwise on the same
+    topology."""
+    import os
+    import tempfile
+
+    import jax  # noqa: F401  (device init before timing)
+    from lightgbm_tpu import costmodel, telemetry
+    from lightgbm_tpu import checkpoint as ckpt_mod
+    from lightgbm_tpu.config import OverallConfig
+    from lightgbm_tpu.io.dataset import Dataset
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.objectives import create_objective
+    from lightgbm_tpu.utils import log
+
+    log.set_stream(sys.stderr)
+    log.set_level(log.WARNING)
+    telemetry.enable()
+    telemetry.reset()
+
+    train_rows = min(args.rows, 1_000_000)
+    iters = min(args.iters, 64)
+    narrow = (args.narrow_features if args.narrow_features >= 0
+              else (args.features * 6) // 7)
+    x, y = make_data(train_rows, args.features, narrow_features=narrow)
+    ds = Dataset.from_arrays(x, y, max_bin=args.max_bin)
+
+    base_params = {
+        "objective": "binary",
+        "num_leaves": str(args.leaves),
+        "min_data_in_leaf": "100",
+        "min_sum_hessian_in_leaf": "10.0",
+        "learning_rate": "0.1",
+        "grow_policy": args.grow_policy,
+        "hist_dtype": args.hist_dtype,
+    }
+
+    def build(extra=None):
+        params = dict(base_params)
+        if extra:
+            params.update(extra)
+        cfg = OverallConfig()
+        cfg.set(params, require_data=False)
+        b = GBDT()
+        b.init(cfg.boosting_config, ds,
+               create_objective(cfg.objective_type, cfg.objective_config))
+        return b
+
+    def timed_run(extra=None):
+        b = build(extra)
+        t0 = time.perf_counter()
+        b.run_training(iters, is_eval=False)
+        import jax as _jax
+        _jax.block_until_ready(b.score)
+        return iters / (time.perf_counter() - t0), b
+
+    # warmup compiles the shared chunk programs for both arms
+    timed_run()
+    off_samples, on_samples, overheads = [], [], []
+    writes = 0
+    with tempfile.TemporaryDirectory() as td:
+        for r in range(max(1, args.repeats)):
+            off, _ = timed_run()
+            cdir = os.path.join(td, "r%d" % r)
+            on, b_on = timed_run({"checkpoint_interval": "1",
+                                  "checkpoint_dir": cdir,
+                                  "checkpoint_keep": "2"})
+            # checkpoints actually WRITTEN (not the post-prune retained
+            # count): the booster records its writer's totals at close
+            writes = max(writes,
+                         (b_on._ckpt_stats or {}).get("written", 0))
+            dropped = (b_on._ckpt_stats or {}).get("dropped", 0)
+            off_samples.append(off)
+            on_samples.append(on)
+            overheads.append(100.0 * (off - on) / on)
+        # restore contract: uninterrupted vs checkpoint-resumed, bitwise
+        ref, b_ref = timed_run()
+        ref_trees = [t.to_string() for t in b_ref.models]
+        ref_score = np.asarray(b_ref.score)
+        cdir = os.path.join(td, "restore")
+        half = max(iters // 2, 1)
+        b_half = build({"checkpoint_interval": "1",
+                        "checkpoint_dir": cdir})
+        b_half.run_training(half, is_eval=False)
+        latest = ckpt_mod.latest_checkpoint(cdir)
+        b_res = build()
+        b_res.restore_checkpoint(ckpt_mod.load_checkpoint(latest))
+        b_res.run_training(iters - b_res.iter, is_eval=False)
+        exact = (ref_trees == [t.to_string() for t in b_res.models]
+                 and np.array_equal(ref_score, np.asarray(b_res.score)))
+
+    med_over = float(np.median(overheads))
+    out = {
+        "metric": f"ckpt_overhead_higgs{train_rows // 1000}k_"
+                  f"leaves{args.leaves}",
+        "unit": "pct",
+        "host": costmodel.host_fingerprint(),
+        "ckpt_interval": 1,
+        # clamp at 0: a negative sample is timing noise, and the gated
+        # must-not-grow lane wants the cost, not the noise sign
+        "ckpt_overhead_pct": round(max(med_over, 0.0), 4),
+        # spread in percentage POINTS (the lane's own noise band)
+        "ckpt_spread": round(max(overheads) - min(overheads), 4),
+        "ckpt_overhead_samples": [round(o, 4) for o in overheads],
+        "ckpt_off_iters_per_sec": round(float(np.median(off_samples)), 4),
+        "ckpt_on_iters_per_sec": round(float(np.median(on_samples)), 4),
+        "ckpt_writes": int(writes),
+        "ckpt_dropped": int(dropped),
+        "ckpt_restore_exact": bool(exact),
+    }
+    telemetry.disable()
+    print(json.dumps(out))
+    return 0
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     # 11M rows is the headline scale (BASELINE.md north star: Higgs-11M,
@@ -801,6 +937,13 @@ def main() -> int:
                              "coalescing ServingFront, plus a mid-load "
                              "drain-and-flip hot swap with dropped/"
                              "misscored counts (both must be 0)")
+    parser.add_argument("--bench-ckpt", action="store_true",
+                        help="checkpoint-cost benchmark (ISSUE 14): "
+                             "run_training with checkpoint_interval=1 vs "
+                             "off (the ckpt_overhead_pct must-not-grow "
+                             "lane) plus the bit-identical restore "
+                             "contract (ckpt_restore_exact; False fails "
+                             "the perf gate absolutely)")
     parser.add_argument("--serve-shards", type=int, default=0,
                         help="tree-shard the --bench-serve engines over "
                              "this many devices (0 = single-device; "
@@ -820,6 +963,8 @@ def main() -> int:
         return bench_serve(args)
     if args.bench_wire:
         return bench_wire(args)
+    if args.bench_ckpt:
+        return bench_ckpt(args)
     if (args.hist_dtype != "int8" and args.rows > 4_000_000
             and args.grow_policy == "depthwise"):
         # one fused dispatch of --iters f32 iterations at this scale would
@@ -1226,6 +1371,19 @@ def main() -> int:
                   ["--bench-serve", "--max-bin", str(args.max_bin),
                    "--iters", str(args.iters)],
                   [(k, k) for k in SERVE_COPY_KEYS])
+
+    run_ckpt = not args.skip_parity
+    if run_ckpt:
+        # checkpoint-cost lane (ISSUE 14): ckpt_overhead_pct rides the
+        # must-not-grow latency lane and ckpt_restore_exact=False is an
+        # ABSOLUTE perf_gate finding (a non-bit-identical same-topology
+        # restore must never pass a recorded round unnoticed).
+        sub_bench("ckpt",
+                  ["--bench-ckpt", "--max-bin", str(args.max_bin),
+                   "--iters", str(args.iters),
+                   "--grow-policy", args.grow_policy,
+                   "--hist-dtype", args.hist_dtype],
+                  [(k, k) for k in CKPT_COPY_KEYS])
 
     run_ingest = not args.skip_parity
     if run_ingest:

@@ -208,6 +208,34 @@ def parallel_grow_program(tree_learner: str, hist_dtype: str = "float32",
     return Program(name, mapped, _small_data(), (), F, B)
 
 
+def elastic_programs(shards: int = 2) -> "List[Program]":
+    """The elastic-training exchange programs (ISSUE 14), built from
+    ``lightgbm_tpu.elastic``'s OWN shard_map constructors over a real
+    ``(data,)`` mesh — so the census covers the
+    ``elastic/times_allgather`` (per-host iteration seconds) and
+    ``elastic/survivor_pmin`` (mesh-shrink agreement) seams against what
+    the live straggler policy actually executes."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    from ..elastic import mapped_times_fn, mapped_vote_fn
+    from ..parallel.mesh import DATA_AXIS
+
+    if len(jax.devices()) < shards:
+        raise RuntimeError(
+            "jaxpr layer needs %d devices (set XLA_FLAGS="
+            "--xla_force_host_platform_device_count=8 before importing "
+            "jax, as scripts/graftlint.py and tests/conftest.py do)"
+            % shards)
+    import numpy as np
+    mesh = Mesh(np.array(jax.devices()[:shards]), (DATA_AXIS,))
+    times = Program("elastic/times_allgather", mapped_times_fn(mesh),
+                    (jnp.zeros((shards,), jnp.float32),), (), F, B)
+    votes = Program("elastic/survivor_pmin", mapped_vote_fn(mesh),
+                    (jnp.ones((shards,), jnp.int32),), (), F, B)
+    return [times, votes]
+
+
 def canonical_programs(parallel: bool = True) -> "List[Program]":
     """The full inventory.  ``parallel=False`` restricts to programs that
     need no multi-device platform (serial + serving + the axis_env hist
@@ -232,6 +260,9 @@ def canonical_programs(parallel: bool = True) -> "List[Program]":
             sharded_serving_program("float32"),
             sharded_serving_program("int8"),
         ])
+        # elastic-training exchanges (ISSUE 14): times allgather +
+        # survivor pmin, censused against the live policy's programs
+        programs.extend(elastic_programs())
     return programs
 
 

@@ -81,6 +81,32 @@ uniform, with a warning under ``mixed_bin=true``, when an ownership
 block has no narrow feature).
 ``streaming``/``ingest_chunk_rows``/``bagging_device`` are
 model-invariant; ``goss`` changes the trained model by design.
+
+Preemption-safe elastic training knobs (ISSUE 14 —
+lightgbm_tpu/checkpoint.py + elastic.py): ``checkpoint_interval``
+(iterations between asynchronous atomic checkpoints; 0 = off; > 0
+REQUIRES ``checkpoint_dir`` — loud reject otherwise) and
+``checkpoint_dir`` (where the ``ckpt-<iter>.json`` files live; a
+``task=train`` restart pointing at a dir holding a checkpoint RESUMES
+from the latest one: bit-identical continuation — model text, scores,
+RNG streams — on the same topology, the documented cross-schedule
+budgets on a different ``num_machines``, where ``factor_machines``
+re-runs on the surviving count and the binary cache re-shards through
+the streaming loader).  ``checkpoint_keep`` (>= 1, loud reject at 0)
+bounds retained checkpoint files; the write-temp+rename discipline
+guarantees a crash mid-write leaves the previous checkpoint loadable.
+``elastic_shrink`` (true/false; requires a parallel ``tree_learner`` —
+loud reject under serial) arms the live straggler policy: the
+persistent-straggler rule (same implementation as
+scripts/timeline_report.py, ``straggler_k`` >= 1 consecutive
+strictly-slowest iterations) triggers a drain-at-iteration-boundary
+mesh shrink — checkpoint, drop the flagged slot, re-factor, resume.
+``checkpoint_*`` knobs are model-invariant (a resumed run reproduces
+the uninterrupted one); ``elastic_shrink`` changes topology mid-run and
+therefore lands in the same cross-schedule budget class as choosing
+that topology at startup.  ``LGBM_TPU_FAULT_AT=<iter>[,<kind>]``
+(lightgbm_tpu/faults.py) is the test/harness hatch that kills or stalls
+the designated process at an iteration boundary.
 """
 from __future__ import annotations
 
@@ -177,6 +203,42 @@ class Application:
         for valid_data, metrics, name in self.valid_datas:
             self.boosting.add_valid_dataset(valid_data, metrics, name=name)
 
+        # preemption-safe restart (ISSUE 14): a checkpoint_dir holding a
+        # finished checkpoint resumes training from it — bit-identically
+        # on the same topology; on a different num_machines the learner's
+        # mesh was already re-factored above (factor_machines over the
+        # surviving machine count) and the binary cache re-sharded
+        # through the streaming loader, so the restore replays onto the
+        # new layout (the documented elastic continuation budgets).
+        bc = self.config.boosting_config
+        if bc.checkpoint_dir:
+            from . import checkpoint as ckpt_mod
+            latest = ckpt_mod.latest_checkpoint(bc.checkpoint_dir)
+            if latest is not None:
+                log.info("resuming from checkpoint %s" % latest)
+                self.boosting.restore_checkpoint(latest)
+        if bc.elastic_shrink and self.config.is_parallel:
+            # live straggler mesh-shrink (ISSUE 14): the factory re-runs
+            # factor_machines through create_parallel_learner on the
+            # surviving machine count; an explicit feature_shards that no
+            # longer divides falls back to auto-factoring (with a note)
+            # instead of a mid-run fatal
+            from .parallel import create_parallel_learner as _factory_cpl
+            cfg = self.config
+
+            def _shrunk_learner(num_machines, _cfg=cfg):
+                _cfg.network_config.num_machines = int(num_machines)
+                fs = _cfg.boosting_config.tree_config.feature_shards
+                if fs and int(num_machines) % fs:
+                    log.warning(
+                        "elastic shrink: feature_shards=%d does not "
+                        "divide the surviving %d machines; re-factoring "
+                        "automatically" % (fs, num_machines))
+                    _cfg.boosting_config.tree_config.feature_shards = 0
+                return _factory_cpl(_cfg)
+
+            self.boosting.enable_elastic(_shrunk_learner)
+
     def load_data(self, predict_fun=None) -> None:
         """Application::LoadData (application.cpp:119-199)."""
         # perf_counter, not time.time(): wall clock is not monotonic (NTP
@@ -251,10 +313,20 @@ class Application:
         is_eval = bool(self.train_metrics) or any(
             m for _, m, _ in self.valid_datas)
         start = time.perf_counter()
+        # a checkpoint restore already banked boosting.iter iterations;
+        # num_iterations is the TOTAL budget of the run, so train only
+        # the remainder (a restart after a clean finish trains nothing
+        # and just rewrites the final model file)
+        remaining = max(
+            self.config.boosting_config.num_iterations - self.boosting.iter,
+            0)
+        if remaining < self.config.boosting_config.num_iterations:
+            log.info("checkpoint restore banked %d iteration(s); training "
+                     "%d more" % (self.boosting.iter, remaining))
 
         def _run():
             self.boosting.run_training(
-                self.config.boosting_config.num_iterations, is_eval,
+                remaining, is_eval,
                 save_fn=lambda: self.boosting.save_model_to_file(
                     False, self.config.io_config.output_model),
                 progress_fn=lambda it: log.info(

@@ -642,6 +642,33 @@ class BoostingConfig:
     goss: bool = False
     top_rate: float = 0.2
     other_rate: float = 0.1
+    # Preemption-safe training (ISSUE 14, lightgbm_tpu/checkpoint.py):
+    # checkpoint_interval > 0 makes run_training write an atomic
+    # checkpoint file (model + sampler/RNG counters + iteration +
+    # best_score/best_iter + config fingerprint) every that-many
+    # consumed iterations, on a background writer thread OFF the
+    # pipelined readback path — plus one synchronous final checkpoint.
+    # A task=train restart with the same checkpoint_dir resumes from the
+    # latest checkpoint: bit-identically on the same topology, at the
+    # documented cross-schedule budget on a different one (elastic
+    # restart re-runs factor_machines on the surviving machine count).
+    # 0 disables.  checkpoint_dir must be set when the interval is;
+    # checkpoint_keep (>= 1) bounds how many finished checkpoint files
+    # are retained (the atomic write-temp+rename discipline means a
+    # crash mid-write always leaves the previous one loadable).
+    checkpoint_interval: int = 0
+    checkpoint_dir: str = ""
+    checkpoint_keep: int = 2
+    # Live straggler mitigation (ISSUE 14, lightgbm_tpu/elastic.py):
+    # elastic_shrink=true arms the drain-at-iteration-boundary mesh
+    # shrink — when the persistent-straggler rule (the SAME
+    # strictly-slowest->=straggler_k-consecutive-iterations logic
+    # scripts/timeline_report.py flags post-mortem) fires, the trainer
+    # checkpoints, drops the flagged slot, re-runs factor_machines on
+    # the surviving machine count and resumes.  Requires a parallel
+    # tree_learner (there is no mesh to shrink under serial).
+    elastic_shrink: bool = False
+    straggler_k: int = 3
     tree_config: TreeConfig = dataclasses.field(default_factory=TreeConfig)
 
     def set(self, params: Dict[str, str]) -> None:
@@ -701,6 +728,24 @@ class BoostingConfig:
             if self.bagging_fraction < 1.0 and self.bagging_freq > 0:
                 log.fatal("Cannot use bagging in GOSS mode "
                           "(goss=true with bagging_fraction < 1)")
+        self.checkpoint_interval = _get_int(params, "checkpoint_interval",
+                                            self.checkpoint_interval)
+        log.check(self.checkpoint_interval >= 0,
+                  "checkpoint_interval should be >= 0 (0 disables)")
+        self.checkpoint_dir = _get_str(params, "checkpoint_dir",
+                                       self.checkpoint_dir)
+        if self.checkpoint_interval > 0 and not self.checkpoint_dir:
+            log.fatal("checkpoint_interval > 0 requires checkpoint_dir "
+                      "(where should the checkpoints go?)")
+        self.checkpoint_keep = _get_int(params, "checkpoint_keep",
+                                        self.checkpoint_keep)
+        log.check(self.checkpoint_keep >= 1,
+                  "checkpoint_keep should be >= 1 (the latest checkpoint "
+                  "must survive)")
+        self.elastic_shrink = _get_bool(params, "elastic_shrink",
+                                        self.elastic_shrink)
+        self.straggler_k = _get_int(params, "straggler_k", self.straggler_k)
+        log.check(self.straggler_k >= 1, "straggler_k should be >= 1")
         if "tree_learner" in params:
             value = params["tree_learner"].lower()
             if value == "serial":
@@ -829,6 +874,10 @@ class OverallConfig:
         if self.boosting_config.tree_learner == "serial":
             self.is_parallel = False
             self.network_config.num_machines = 1
+        if self.boosting_config.elastic_shrink and not self.is_parallel:
+            log.fatal("elastic_shrink=true requires a parallel "
+                      "tree_learner and num_machines > 1 (there is no "
+                      "mesh to shrink under serial training)")
         if self.boosting_config.tree_learner in ("serial", "feature"):
             self.is_parallel_find_bin = False
         elif self.boosting_config.tree_learner in ("data", "hybrid",

@@ -1,0 +1,313 @@
+"""Elastic-training support (ISSUE 14): the straggler/skew logic shared
+by ``scripts/timeline_report.py`` and the live trainer policy, plus the
+small collective programs the elastic path runs on the mesh.
+
+The persistent-straggler rule was born in the timeline report (PR 5):
+one host STRICTLY slowest ``k`` consecutive iteration numbers — ties
+never count, and a gap in the compared iterations resets the run rather
+than bridging it (a truncated shard can't manufacture consecutiveness).
+The trainer's live mesh-shrink policy must flag exactly the same hosts
+the post-mortem report would, so the logic lives HERE once and both
+consumers import it:
+
+- ``skew_from_rows`` — the full per-phase skew/barrier-wait/straggler
+  report over ``{iteration: {host: {phase: seconds}}}`` rows (the
+  script's shape);
+- ``StragglerTracker`` — the bare run-length state machine;
+- ``StragglerMonitor`` — the trainer-side consumer: feed per-iteration
+  per-host totals (from the cross-host time exchange, or injected by
+  the fault-injection harness), read the flagged host at iteration
+  boundaries.
+
+Collectives (wire sites ``elastic/times_allgather`` and
+``elastic/survivor_pmin``, censused by graftlint J2 via
+``analysis/programs.elastic_programs``):
+
+- ``exchange_times`` — every host's per-iteration seconds all_gathered
+  over a 1-D ``(data,)`` mesh, so each host holds the identical vector
+  and the deterministic straggler rule reaches the same verdict
+  everywhere (no leader election needed);
+- ``agree_survivors`` — elementwise ``pmin`` over per-host vote vectors:
+  the drop decision every survivor commits to before the drain (a host
+  that disagrees can only make the plan MORE conservative, never less).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from . import telemetry
+from .utils import log
+
+CANONICAL_PHASES = ("histogram", "split_find", "partition", "eval")
+
+
+def median(vals: List[float]) -> float:
+    s = sorted(vals)
+    n = len(s)
+    return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+
+def slowest_unique(totals: Dict[str, float]) -> Optional[str]:
+    """The STRICTLY slowest host of one iteration, or None on a tie /
+    all-zero totals (a tie is not a straggler)."""
+    if not totals:
+        return None
+    t_max = max(totals.values())
+    if t_max <= 0:
+        return None
+    if sum(1 for v in totals.values() if v == t_max) != 1:
+        return None
+    return max(totals, key=lambda h: totals[h])
+
+
+class StragglerTracker:
+    """Run-length state machine for the persistent-straggler rule: same
+    host strictly slowest >= k CONSECUTIVE iteration numbers.  Gaps in
+    the fed iteration numbers reset the run; ``None`` (tie / no signal)
+    resets it too."""
+
+    def __init__(self, k: int = 3):
+        self.k = max(int(k), 1)
+        self.run_host: Optional[str] = None
+        self.run_len = 0
+        self.prev_it: Optional[int] = None
+        self.flagged: Optional[str] = None
+
+    def update(self, iteration: int, slowest: Optional[str]) -> Optional[str]:
+        """Feed one iteration's strictly-slowest host (or None); returns
+        the flagged host once the run reaches k, else None."""
+        if (slowest is not None and slowest == self.run_host
+                and self.prev_it is not None
+                and iteration == self.prev_it + 1):
+            self.run_len += 1
+        else:
+            self.run_host, self.run_len = slowest, 1
+        self.prev_it = iteration
+        if self.run_host is not None and self.run_len >= self.k:
+            self.flagged = self.run_host
+            return self.run_host
+        return None
+
+    def reset(self) -> None:
+        self.run_host, self.run_len, self.prev_it = None, 0, None
+        self.flagged = None
+
+
+def skew_from_rows(rows: Dict[int, Dict[str, Dict[str, float]]],
+                   straggler_k: int = 3) -> dict:
+    """Per-phase cross-host skew + barrier-wait decomposition + the
+    persistent-straggler flag over ``{iteration: {host: {phase: s}}}``
+    rows — the ONE implementation behind scripts/timeline_report.py's
+    report and the trainer's live policy.  Needs >= 2 hosts with
+    overlapping iteration records; degrades to an empty report."""
+    multi = {it: hosts for it, hosts in rows.items() if len(hosts) >= 2}
+    phases: Dict[str, dict] = {}
+    barrier_wait: Dict[str, float] = {}
+    tracker = StragglerTracker(straggler_k)
+    for it in sorted(multi):
+        hosts = multi[it]
+        it_phases = sorted({p for pt in hosts.values() for p in pt})
+        totals = {h: sum(pt.values()) for h, pt in hosts.items()}
+        t_max = max(totals.values())
+        tracker.update(it, slowest_unique(totals))
+        for h, tot in totals.items():
+            # time this host spends idle at the collectives waiting for
+            # the slowest peer of the iteration
+            barrier_wait[h] = barrier_wait.get(h, 0.0) + (t_max - tot)
+        for p in it_phases:
+            vals = [pt.get(p, 0.0) for pt in hosts.values()]
+            med = median(vals)
+            if med <= 0:
+                continue
+            ratio = max(vals) / med
+            blk = phases.setdefault(p, {"max_skew": 0.0, "ratios": []})
+            blk["max_skew"] = max(blk["max_skew"], ratio)
+            blk["ratios"].append(ratio)
+    for p, blk in phases.items():
+        blk["mean_skew"] = round(sum(blk["ratios"]) / len(blk["ratios"]), 4)
+        blk["iterations"] = len(blk.pop("ratios"))
+        blk["max_skew"] = round(blk["max_skew"], 4)
+    return {
+        "iterations_compared": len(multi),
+        "hosts": sorted({h for hosts in multi.values() for h in hosts}),
+        "phases": phases,
+        "max_phase_skew": round(max(
+            [b["max_skew"] for b in phases.values()] or [0.0]), 4),
+        "barrier_wait_s": {h: round(v, 6)
+                           for h, v in sorted(barrier_wait.items())},
+        "straggler_k": tracker.k,
+        "persistent_straggler": tracker.flagged,
+    }
+
+
+class StragglerMonitor:
+    """Trainer-side live policy: feed per-iteration per-host wall-time
+    totals (label -> seconds), take the flagged host at an iteration
+    boundary.  Observations come from ``exchange_times`` in real
+    multi-host runs, or are injected by tests/the fault harness —
+    training never blocks on missing observations (no signal = no
+    straggler)."""
+
+    def __init__(self, k: int = 3):
+        self._tracker = StragglerTracker(k)
+        self._flagged: Optional[str] = None
+        self._obs_n = 0
+
+    @property
+    def k(self) -> int:
+        return self._tracker.k
+
+    def observe(self, iteration: int,
+                host_totals: Dict[str, float]) -> Optional[str]:
+        # the tracker's consecutiveness is over the fed sequence numbers;
+        # live observations arrive once per iteration BOUNDARY — which is
+        # once per CHUNK on the fused path, where raw iteration numbers
+        # jump by chunk_size and would reset the run on every
+        # observation.  Consecutive OBSERVATIONS are the live rule, so
+        # the monitor feeds its own monotone counter (``iteration`` is
+        # kept in the signature for log/context parity with the
+        # post-mortem rows, whose per-iteration-number gap-reset
+        # semantics stay in skew_from_rows).
+        self._obs_n += 1
+        flagged = self._tracker.update(self._obs_n,
+                                       slowest_unique(host_totals))
+        if flagged is not None:
+            self._flagged = flagged
+        return flagged
+
+    def feed(self, iteration: int, host_totals: Dict[str, float]) -> None:
+        """Alias of observe() for harness/injection callers."""
+        self.observe(iteration, host_totals)
+
+    def take_flagged(self) -> Optional[str]:
+        """The flagged host, consumed: the caller is acting on it (mesh
+        shrink), so the run-length state resets for the NEW topology."""
+        flagged, self._flagged = self._flagged, None
+        if flagged is not None:
+            self._tracker.reset()
+        return flagged
+
+    def reset(self) -> None:
+        self._tracker.reset()
+        self._flagged = None
+        self._obs_n = 0
+
+
+# ----------------------------------------------------- mesh collectives
+
+# jitted exchange programs per 1-D mesh (the mesh object hashes its device
+# assignment, so a rebuilt/shrunk mesh never reuses a stale program)
+_TIMES_PROGRAMS: dict = {}
+_VOTE_PROGRAMS: dict = {}
+
+
+def _flat_mesh(mesh):
+    """Any training mesh -> a 1-D ``(data,)`` mesh over the same devices
+    (the elastic exchanges are per-HOST scalars; the 2-D hybrid factoring
+    is irrelevant to them)."""
+    from jax.sharding import Mesh
+    from .parallel.mesh import DATA_AXIS
+    devs = np.asarray(mesh.devices).reshape(-1)
+    if tuple(mesh.axis_names) == (DATA_AXIS,):
+        return mesh
+    return Mesh(devs, (DATA_AXIS,))
+
+
+def mapped_times_fn(mesh):
+    """The all_gather exchange shard_mapped over ``mesh`` — exported
+    unjitted so analysis/programs.py can census the EXACT program the
+    trainer runs."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from .parallel.learners import shard_map
+    from .parallel.mesh import DATA_AXIS
+
+    gather = telemetry.collective_span(
+        "elastic/times_allgather",
+        lambda v: jax.lax.all_gather(v, DATA_AXIS),
+        kind="all_gather", axis=DATA_AXIS, phase="elastic")
+
+    def fn(t):
+        # t: this shard's [1] seconds -> the replicated [n] vector
+        return gather(t).reshape(-1)
+
+    return shard_map(fn, mesh=mesh, in_specs=(P(DATA_AXIS),),
+                     out_specs=P())
+
+
+def mapped_vote_fn(mesh):
+    """The survivor-agreement exchange: elementwise ``pmin`` over each
+    host's replicated vote vector — every survivor commits to the SAME
+    (most conservative) plan before the drain."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from .parallel.learners import shard_map
+    from .parallel.mesh import DATA_AXIS
+
+    agree = telemetry.collective_span(
+        "elastic/survivor_pmin",
+        lambda v: jax.lax.pmin(v, DATA_AXIS),
+        kind="pmin", axis=DATA_AXIS, phase="elastic")
+
+    def fn(votes):
+        return agree(votes)
+
+    return shard_map(fn, mesh=mesh, in_specs=(P(),), out_specs=P())
+
+
+def exchange_times(mesh, seconds: float) -> np.ndarray:
+    """All hosts' per-iteration seconds, gathered device-slot-wise over
+    the (flattened) mesh: returns the identical [n_devices] float32
+    vector on every host.  Single-process meshes yield a constant vector
+    (one host's clock) — the monitor's strictly-slowest rule then never
+    fires, by design."""
+    import jax
+    import jax.numpy as jnp
+    mesh1d = _flat_mesh(mesh)
+    key = mesh1d
+    prog = _TIMES_PROGRAMS.get(key)
+    if prog is None:
+        prog = _TIMES_PROGRAMS[key] = jax.jit(mapped_times_fn(mesh1d))
+    n = int(np.asarray(mesh1d.devices).size)
+    if jax.process_count() > 1:
+        from jax.sharding import NamedSharding, PartitionSpec
+        from .parallel.mesh import DATA_AXIS
+        local = np.full(jax.local_device_count(), np.float32(seconds))
+        arr = jax.make_array_from_process_local_data(
+            NamedSharding(mesh1d, PartitionSpec(DATA_AXIS)), local, (n,))
+    else:
+        arr = jnp.full((n,), np.float32(seconds))
+    with telemetry.span("elastic"):
+        out = np.asarray(prog(arr))
+    return out
+
+
+def agree_survivors(mesh, votes: np.ndarray) -> np.ndarray:
+    """Elementwise minimum of every host's int32 vote vector (replicated
+    shapes); the agreed plan all survivors act on."""
+    import jax
+    import jax.numpy as jnp
+    mesh1d = _flat_mesh(mesh)
+    key = mesh1d
+    prog = _VOTE_PROGRAMS.get(key)
+    if prog is None:
+        prog = _VOTE_PROGRAMS[key] = jax.jit(mapped_vote_fn(mesh1d))
+    with telemetry.span("elastic"):
+        out = np.asarray(prog(jnp.asarray(np.asarray(votes, np.int32))))
+    return out
+
+
+def host_times_from_gather(gathered: np.ndarray,
+                           slots_per_host: int = 1) -> Dict[str, float]:
+    """The gathered per-device-slot vector -> per-host totals labeled
+    ``p<i>`` (timeline_report's shard labels), one host per
+    ``slots_per_host`` consecutive slots."""
+    gathered = np.asarray(gathered, np.float64).reshape(-1)
+    sph = max(int(slots_per_host), 1)
+    out: Dict[str, float] = {}
+    for i in range(0, gathered.size, sph):
+        out["p%d" % (i // sph)] = float(gathered[i])
+    return out

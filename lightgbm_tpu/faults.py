@@ -1,0 +1,140 @@
+"""Fault-injection hooks for preemption/straggler testing (ISSUE 14).
+
+``LGBM_TPU_FAULT_AT=<iter>[,<kind>]`` arms a one-shot fault that fires at
+the first iteration boundary at or past ``<iter>`` in ``run_training``
+(between iterations — never mid-dispatch), on the designated process only
+(``LGBM_TPU_FAULT_PROC``, default 0).  Kinds:
+
+- ``kill`` (default): ``SIGKILL`` the process — the preemption the
+  checkpoint/restore machinery exists for.  No Python cleanup runs, which
+  is exactly the point: durability must come from the already-written
+  atomic checkpoints, not from exit handlers.
+- ``stall``: sleep ``LGBM_TPU_FAULT_STALL_S`` seconds (default 1.0) once
+  — a synthetic persistent straggler / hung-host window for the
+  watchdog and mesh-shrink paths.
+- ``raise``: raise ``RuntimeError("injected fault ...")`` — exercises
+  the crash-flush path deterministically.
+
+Unit tests arm programmatically with ``arm()``/``disarm()`` instead of
+the env var.  Either way the hatch is process-global state: the conftest
+leak guard fails any test that leaves it armed (a later test's training
+loop would be killed by a foreign fault).
+
+No test should ever need to race a real preemption: the dryrun harness
+rows and tests/test_checkpoint.py / tests/test_elastic.py all drive this
+hatch.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import time
+from typing import Optional, Tuple
+
+from .utils import log
+
+ENV_VAR = "LGBM_TPU_FAULT_AT"
+ENV_PROC = "LGBM_TPU_FAULT_PROC"
+ENV_STALL_S = "LGBM_TPU_FAULT_STALL_S"
+KINDS = ("kill", "stall", "raise")
+
+# programmatic arming (tests): (iteration, kind, proc) or None
+_armed: Optional[Tuple[int, str, int]] = None
+_fired = False
+
+
+def parse_spec(spec: str) -> Tuple[int, str]:
+    """``"<iter>[,<kind>]"`` -> (iteration, kind); loud reject on junk."""
+    parts = [p.strip() for p in str(spec).split(",") if p.strip()]
+    if not parts:
+        log.fatal("%s must be '<iter>[,<kind>]', got %r" % (ENV_VAR, spec))
+    try:
+        iteration = int(parts[0])
+    except ValueError:
+        log.fatal("%s iteration must be an int, got %r"
+                  % (ENV_VAR, parts[0]))
+    if iteration < 0:
+        log.fatal("%s iteration must be >= 0, got %d" % (ENV_VAR, iteration))
+    kind = parts[1] if len(parts) > 1 else "kill"
+    if kind not in KINDS:
+        log.fatal("%s kind must be one of %s, got %r"
+                  % (ENV_VAR, "/".join(KINDS), kind))
+    if len(parts) > 2:
+        log.fatal("%s takes at most '<iter>,<kind>', got %r"
+                  % (ENV_VAR, spec))
+    return iteration, kind
+
+
+def arm(iteration: int, kind: str = "kill", proc: int = 0) -> None:
+    """Programmatic arming (unit tests) — beats the env var."""
+    global _armed, _fired
+    if kind not in KINDS:
+        log.fatal("fault kind must be one of %s, got %r"
+                  % ("/".join(KINDS), kind))
+    _armed = (int(iteration), kind, int(proc))
+    _fired = False
+
+
+def disarm() -> None:
+    global _armed, _fired
+    _armed = None
+    _fired = False
+
+
+def armed() -> bool:
+    """True when a fault hatch is live — programmatic OR env (the conftest
+    leak guard consults this after every test)."""
+    return _armed is not None or bool(os.environ.get(ENV_VAR))
+
+
+def _spec() -> Optional[Tuple[int, str, int]]:
+    if _armed is not None:
+        return _armed
+    env = os.environ.get(ENV_VAR)
+    if not env:
+        return None
+    iteration, kind = parse_spec(env)
+    proc = int(os.environ.get(ENV_PROC, "0"))
+    return iteration, kind, proc
+
+
+def maybe_fire(iteration: int) -> None:
+    """Fire the armed fault once the training loop reaches its iteration
+    (called from ``run_training`` at iteration boundaries).  No-op when
+    nothing is armed, when this is not the designated process, or when a
+    one-shot fault already fired."""
+    global _fired
+    if _fired:
+        return
+    spec = _spec()
+    if spec is None:
+        return
+    at, kind, proc = spec
+    if iteration < at:
+        return
+    try:
+        import jax
+        if jax.process_index() != proc:
+            return
+    except Exception:
+        if proc != 0:
+            return
+    _fired = True
+    if kind == "kill":
+        log.warning("fault injection: SIGKILL at iteration %d" % iteration)
+        # flush whatever the log layer buffers — SIGKILL runs no handlers
+        try:
+            import sys
+            sys.stdout.flush()
+            sys.stderr.flush()
+        except Exception:
+            pass
+        os.kill(os.getpid(), signal.SIGKILL)
+    elif kind == "stall":
+        stall = float(os.environ.get(ENV_STALL_S, "1.0"))
+        log.warning("fault injection: stalling %.3fs at iteration %d"
+                    % (stall, iteration))
+        time.sleep(stall)
+    else:
+        log.warning("fault injection: raising at iteration %d" % iteration)
+        raise RuntimeError("injected fault at iteration %d" % iteration)

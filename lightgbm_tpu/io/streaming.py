@@ -49,7 +49,15 @@ schedule unchanged.
 Binary caches stream both ways: a native cache is READ via ``np.memmap``
 row-chunks (no full host materialization), and ``is_save_binary_file``
 under streaming WRITES the cache through a memmap during pass 2 —
-byte-identical to the resident ``save_binary`` output.
+byte-identical to the resident ``save_binary`` output.  Because the
+cache is byte-identical and the memmap reader takes the consuming
+learner's ``shard_rows``/``shard_devices`` at LOAD time, the cache is
+also the elastic-restart re-shard vehicle (ISSUE 14): a ``task=train``
+restart on a SHRUNK topology (fewer ``num_machines`` after a
+preemption) re-opens the same cache and commits the identical bin
+matrix onto the re-factored mesh — the dryrun harness's kill-restart
+row and the checkpoint restore's bit-exactness guarantees ride exactly
+this property.
 
 Telemetry: the whole load runs under an ``ingest`` span (sub-spans
 ``ingest_count``/``ingest_pass1``/``ingest_bin``/``ingest_h2d``) and

@@ -22,6 +22,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import faults as faults_mod
 from .. import telemetry
 from ..utils import log
 from ..ops.scoring import add_tree_score
@@ -92,6 +93,19 @@ class GBDT:
         self._pipe = None
         self._pipe_chunk = None
         self._pipeline_auto = False
+        # preemption-safe elastic training (ISSUE 14): the live straggler
+        # policy (elastic.StragglerMonitor, armed via enable_elastic),
+        # the learner factory a mesh shrink rebuilds with, the active
+        # async checkpoint writer (run_training-scoped), and the last
+        # checkpointed iteration
+        self._straggler_monitor = None
+        self._elastic_exchange_on = False
+        self._ckpt_writer = None
+        self._last_ckpt_iter = 0
+        self._boundary_t = None
+        # written/dropped totals of the last run's checkpoint writer
+        # (recorded at close; the bench ckpt lane reads them)
+        self._ckpt_stats = None
 
     # ------------------------------------------------------------------ init
 
@@ -727,6 +741,385 @@ class GBDT:
             stop = self._consume_chunk(rec, newer_inflight=False) or stop
         return stop
 
+    # --------------------------------------- checkpoint / elastic (ISSUE 14)
+
+    def _consumed_iteration(self) -> int:
+        """The number of fully CONSUMED boosting iterations — the point a
+        checkpoint describes.  Pipelined per-iteration mode advances
+        ``self.iter`` at dispatch, so the in-flight entry's own iteration
+        number is the consumed count; the chunk path advances at
+        consumption, so ``self.iter`` is already right."""
+        if self._pipe is not None:
+            return int(self._pipe["iter_no"])
+        return int(self.iter)
+
+    def checkpoint_fingerprint(self) -> dict:
+        """The semantic config fields a restored run must match exactly
+        (compared field-by-field on load; a mismatch names the field).
+        Topology fields (num_machines / tree_learner / feature_shards)
+        are deliberately absent — an elastic restart changes them by
+        design and the continuation budget is topology's, not the
+        model's."""
+        bc, tc = self.gbdt_config, self.tree_config
+        return {
+            "objective": (type(self.objective).__name__
+                          if self.objective is not None else None),
+            "num_class": int(self.num_class),
+            "learning_rate": float(bc.learning_rate),
+            "bagging_fraction": float(bc.bagging_fraction),
+            "bagging_freq": int(bc.bagging_freq),
+            "bagging_seed": int(bc.bagging_seed),
+            # the RESOLVED stream, not the knob: "auto" resolving to a
+            # different stream on restore would silently fork the draws
+            "bagging_stream": ("device" if self._bag_device
+                               else "host" if self._use_bagging else "off"),
+            "feature_fraction": float(tc.feature_fraction),
+            "feature_fraction_seed": int(tc.feature_fraction_seed),
+            "goss": bool(getattr(bc, "goss", False)),
+            "top_rate": float(getattr(bc, "top_rate", 0.0)),
+            "other_rate": float(getattr(bc, "other_rate", 0.0)),
+            "num_leaves": int(tc.num_leaves),
+            "max_depth": int(tc.max_depth),
+            "min_data_in_leaf": int(tc.min_data_in_leaf),
+            "min_sum_hessian_in_leaf": float(tc.min_sum_hessian_in_leaf),
+            "grow_policy": str(tc.grow_policy),
+            "hist_dtype": str(tc.hist_dtype),
+            "quant_rounding": str(tc.quant_rounding),
+            "early_stopping_round": int(bc.early_stopping_round),
+        }
+
+    def _dataset_fingerprint(self) -> dict:
+        """Topology-independent dataset identity: true global rows (not
+        the padded per-topology layout), feature counts, valid-set
+        count."""
+        return {
+            "num_features": int(self.num_features),
+            "num_total_features": int(self.train_data.num_total_features),
+            "num_rows": int(self._mp_true_n if self._mp
+                            else self.train_data.num_data),
+            "num_valid": len(self.valid_datasets),
+        }
+
+    def _topology_info(self) -> dict:
+        lc = getattr(self._learner, "config", None)
+        nm = (int(lc.network_config.num_machines)
+              if lc is not None else 1)
+        return {
+            "tree_learner": (type(self._learner).__name__
+                             if self._learner is not _serial_learner
+                             else "serial"),
+            "num_machines": nm,
+            "process_count": int(jax.process_count()),
+        }
+
+    def checkpoint_state(self) -> dict:
+        """Raw consistent snapshot of the CONSUMED training state, cheap
+        enough for the hot loop (list copy + RNG get_state; tree
+        serialization happens on the writer thread,
+        checkpoint.serialize_state).  Pipelined mode snapshots the state
+        as-of the consumed boundary: the in-flight entry's pre-dispatch
+        RNG snapshot IS that state (scores are never stored — the
+        restore replays the trees, which the rollback machinery already
+        proved bitwise-equal to the in-grow updates)."""
+        if self._pipe is not None:
+            it = int(self._pipe["iter_no"])
+            rng = self._pipe["pre_rng"]
+            score_ref = self._pipe["score_before"]
+            valid_ref = self._pipe["valid_before"]
+        elif self._pipe_chunk is not None:
+            rec = self._pipe_chunk
+            it = int(self.iter)
+            rng = (rec["bag_state"], rec["ff_states"])
+            score_ref = rec["score_before"]
+            valid_ref = tuple(rec["valid_before"])
+        else:
+            it = int(self.iter)
+            rng = self._rng_snapshot()
+            score_ref = self.score
+            valid_ref = tuple(e["score"] for e in self.valid_datasets)
+        if self._mp:
+            # compact to TRUE global rows now — the gather is a
+            # collective and must run on the main thread; single-process
+            # scores stay device references the writer thread reads
+            score_ref = self._host_global_score(score_ref)
+        return {
+            "iteration": it,
+            "num_class": int(self.num_class),
+            "models": tuple(self.models),
+            "best_score": [list(r) for r in self.best_score],
+            "best_iter": [list(r) for r in self.best_iter],
+            "rng": rng,
+            "score": score_ref,
+            "valid_scores": list(valid_ref),
+            "config": self.checkpoint_fingerprint(),
+            "dataset": self._dataset_fingerprint(),
+            "topology": self._topology_info(),
+        }
+
+    def restore_checkpoint(self, payload) -> None:
+        """Continue training from a checkpoint payload (a loaded dict, or
+        a path).  Must be called on a FRESHLY initialized booster (after
+        ``init`` + ``add_valid_dataset``): the config/dataset
+        fingerprints are compared field-by-field (loud reject naming the
+        field), trees, RNG streams and the raw f32 scores are restored
+        exactly — bit-identical continuation on the same topology; on a
+        different one the stored TRUE-row scores re-lift onto the new
+        layout and the continuation lands in the documented
+        cross-schedule budget class."""
+        from .. import checkpoint as ckpt_mod
+        if isinstance(payload, str):
+            payload = ckpt_mod.load_checkpoint(payload)
+        log.check(self.train_data is not None,
+                  "restore_checkpoint requires init() first")
+        if self.models or self.iter:
+            log.fatal("restore_checkpoint requires a freshly initialized "
+                      "booster (input_model continuation and checkpoint "
+                      "resume are mutually exclusive)")
+        try:
+            ckpt_mod.check_fingerprint(payload,
+                                       self.checkpoint_fingerprint(),
+                                       self._dataset_fingerprint())
+        except ckpt_mod.CheckpointError as e:
+            log.fatal(str(e))
+        topo = payload.get("topology", {})
+        here = self._topology_info()
+        if topo.get("num_machines") not in (None, here["num_machines"]):
+            log.info("elastic restart: checkpoint topology "
+                     "num_machines=%s -> %s (mesh re-factored on the "
+                     "surviving machine count)"
+                     % (topo.get("num_machines"), here["num_machines"]))
+        self.models = [ckpt_mod.tree_from_json(t)
+                       for t in payload["trees"]]
+        self.iter = int(payload["iteration"])
+        self.best_score = [list(map(float, r))
+                           for r in payload["best_score"]]
+        self.best_iter = [list(map(int, r)) for r in payload["best_iter"]]
+        rng = payload["rng"]
+        self._restore_bag_json(rng["bagging"])
+        ff = rng["feature_fraction"]
+        if ff is not None:
+            if len(ff) != len(self._feat_rngs):
+                log.fatal("checkpoint rng field 'feature_fraction' has %d "
+                          "streams, this run has %d classes"
+                          % (len(ff), len(self._feat_rngs)))
+            for r, s in zip(self._feat_rngs, ff):
+                r.set_state(ckpt_mod._rng_state_from_json(s))
+        # install the stored raw f32 scores (true rows), re-lifted onto
+        # THIS topology's layout
+        stored = ckpt_mod.array_from_json(payload["score"])
+        n_true = self._mp_true_n if self._mp else self.train_data.num_data
+        if tuple(stored.shape) != (self.num_class, n_true):
+            log.fatal("checkpoint field 'score' has shape %s, this run "
+                      "needs (%d, %d)" % (tuple(stored.shape),
+                                          self.num_class, n_true))
+        if self._mp:
+            counts = [c for _, c in self._shard_layout]
+            off = sum(counts[:jax.process_index()])
+            local = stored[:, off:off + self._mp_local_n]
+            self.score = self._mp_make_global(local, row_axis=1)
+        elif self._host_inputs:
+            self.score = np.asarray(stored)
+        else:
+            self.score = jnp.asarray(stored)
+        vs = payload["valid_scores"]
+        if len(vs) != len(self.valid_datasets):
+            log.fatal("checkpoint field 'valid_scores' has %d sets, this "
+                      "run configured %d validation dataset(s)"
+                      % (len(vs), len(self.valid_datasets)))
+        for entry, sj in zip(self.valid_datasets, vs):
+            s = ckpt_mod.array_from_json(sj)
+            entry["score"] = (np.asarray(s) if self._host_inputs
+                              else jnp.asarray(s))
+        # a restarted CLI run rewrites its incremental model file from
+        # scratch (fresh header + every tree)
+        if self._model_file is not None and not self._model_file.closed:
+            self._model_file.close()
+        self._saved_model_size = -1
+        self._model_file = None
+        self._last_ckpt_iter = self.iter
+        telemetry.count("ckpt/restored")
+        log.info("restored checkpoint at iteration %d (%d trees)"
+                 % (self.iter, len(self.models)))
+
+    def _restore_bag_json(self, obj) -> None:
+        """Restore the bagging stream from its checkpoint form.  The
+        resolved stream mode already matched via the config fingerprint
+        (``bagging_stream``); device mode restores the draw counter and
+        reconstructs the current mask (a pure function of it), host mode
+        restores the MT19937 state + current mask."""
+        if obj is None:
+            return
+        if obj["mode"] == "device":
+            self._bag_draw_idx = int(obj["draw_idx"])
+            if self._bag_draw_idx > 0:
+                from ..ops import sampling as _sampling
+                n = self.num_data
+                bag_cnt = int(self.gbdt_config.bagging_fraction * n)
+                self._bag_mask_device = _sampling.bag_mask_for_draw(
+                    self._bag_base_key, self._bag_draw_idx - 1, n, bag_cnt)
+            return
+        from .. import checkpoint as ckpt_mod
+        mask = ckpt_mod._mask_from_json(obj["mask"])
+        n_local = self._mp_local_n if self._mp else self.train_data.num_data
+        if mask.size != n_local:
+            log.fatal("checkpoint rng field 'bagging' mask covers %d rows "
+                      "but this process's shard has %d — host-path "
+                      "bagging state is per-shard, so an elastic restart "
+                      "across a different process layout must use "
+                      "bagging_device=true (or bagging off)"
+                      % (mask.size, n_local))
+        self._bag_rng.set_state(ckpt_mod._rng_state_from_json(obj["state"]))
+        self._bag_mask = mask
+        self._bag_mask_device = None
+
+    def enable_elastic(self, learner_factory, monitor=None,
+                       exchange=None):
+        """Arm the live straggler mesh-shrink policy (ISSUE 14):
+        ``learner_factory(num_machines)`` builds the learner for a shrunk
+        mesh (the CLI passes ``create_parallel_learner`` over a mutated
+        config — ``factor_machines`` then re-runs on the surviving
+        count).  ``monitor`` defaults to a fresh
+        ``elastic.StragglerMonitor(straggler_k)``; feed it observations
+        from merged timeline rows or let the per-iteration cross-host
+        time exchange drive it (``exchange``: None = auto, on for true
+        multi-process runs; True/False force).  Returns the monitor so
+        harnesses can inject observations."""
+        from .. import elastic as elastic_mod
+        self._learner_factory = learner_factory
+        if monitor is None:
+            monitor = elastic_mod.StragglerMonitor(
+                k=int(getattr(self.gbdt_config, "straggler_k", 3)
+                      if hasattr(self, "gbdt_config") else 3))
+        self._straggler_monitor = monitor
+        if exchange is None:
+            exchange = jax.process_count() > 1
+        self._elastic_exchange_on = bool(exchange)
+        return monitor
+
+    def _elastic_step(self) -> bool:
+        """One iteration-boundary pass of the live straggler policy:
+        exchange per-host iteration times (when armed), consult the
+        monitor, and execute the drain-at-boundary mesh shrink when a
+        persistent straggler is flagged.  Returns True when draining the
+        pipeline surfaced a stop (training must end)."""
+        mon = self._straggler_monitor
+        if mon is None:
+            return False
+        now = time.perf_counter()
+        if self._elastic_exchange_on and hasattr(self._learner, "_mesh"):
+            if self._boundary_t is not None:
+                from .. import elastic as elastic_mod
+                gathered = elastic_mod.exchange_times(
+                    self._learner._mesh(), now - self._boundary_t)
+                mon.observe(self._consumed_iteration(),
+                            elastic_mod.host_times_from_gather(
+                                gathered,
+                                slots_per_host=jax.local_device_count()))
+        self._boundary_t = now
+        flagged = mon.take_flagged()
+        if flagged is None:
+            return False
+        return self._elastic_shrink(flagged)
+
+    def _elastic_shrink(self, flagged: str) -> bool:
+        """Drain-at-iteration-boundary mesh shrink: checkpoint, drop the
+        flagged slot, re-factor the mesh on the surviving machine count,
+        restore, resume.  Returns True when the drain surfaced a stop
+        (no shrink then — training is over anyway)."""
+        from .. import checkpoint as ckpt_mod
+        from .. import elastic as elastic_mod
+        if self._learner_factory is None or not callable(
+                self._learner_factory):
+            log.warning("persistent straggler %s flagged but no learner "
+                        "factory is registered (enable_elastic); cannot "
+                        "shrink the mesh" % flagged)
+            self._straggler_monitor = None
+            return False
+        lc = getattr(self._learner, "config", None)
+        cur = (int(lc.network_config.num_machines)
+               if lc is not None else 1)
+        if cur <= 1:
+            log.warning("persistent straggler %s flagged but the mesh is "
+                        "already minimal (num_machines=1); cannot shrink"
+                        % flagged)
+            self._straggler_monitor = None
+            return False
+        # drain: consume every in-flight pipelined readback so the
+        # checkpoint describes a clean iteration boundary
+        if self.flush_pipeline():
+            return True
+        state = self.checkpoint_state()
+        if self._ckpt_writer is not None:
+            self._ckpt_writer.write_sync(state)
+        if jax.process_count() > 1:
+            # a live process cannot be evicted from jax.distributed
+            # in-process: the shrink IS the checkpoint+restart protocol —
+            # drain, persist, and tell the supervisor to restart the
+            # survivors (task=train with the same checkpoint_dir re-runs
+            # factor_machines on the surviving count).  Without a
+            # configured checkpoint writer there is nothing durable to
+            # restart FROM — exiting would lose the whole run, so keep
+            # training at the degraded pace and say why.
+            if self._ckpt_writer is None:
+                log.warning(
+                    "persistent straggler %s flagged, but no checkpoint "
+                    "is configured (checkpoint_interval=0) — a "
+                    "multi-process shrink restarts survivors from a "
+                    "checkpoint, so none can happen; continuing at the "
+                    "straggler's pace.  Arm checkpoint_interval/"
+                    "checkpoint_dir to make shrinks recoverable."
+                    % flagged)
+                self._straggler_monitor = None
+                return False
+            log.fatal("persistent straggler %s: checkpoint written; "
+                      "multi-process mesh shrink requires restarting the "
+                      "surviving processes from the checkpoint "
+                      "(task=train, same checkpoint_dir)" % flagged)
+        # survivor agreement on the OLD mesh before tearing it down: each
+        # host votes keep(1)/drop(0) per slot; pmin commits everyone to
+        # the most conservative plan (single-process: trivially agreed,
+        # but the same seam multi-host supervisors consume)
+        try:
+            drop_slot = int(str(flagged).lstrip("p").split("@")[0])
+        except ValueError:
+            drop_slot = cur - 1
+        drop_slot = min(max(drop_slot, 0), cur - 1)
+        votes = np.ones(cur, np.int32)
+        votes[drop_slot] = 0
+        if hasattr(self._learner, "_mesh"):
+            agreed = elastic_mod.agree_survivors(self._learner._mesh(),
+                                                 votes)
+            new_m = int(np.asarray(agreed).sum())
+        else:
+            new_m = cur - 1
+        new_m = max(min(new_m, cur - 1), 1)
+        log.warning("elastic mesh shrink: persistent straggler %s — "
+                    "draining at iteration %d, re-factoring %d -> %d "
+                    "machines" % (flagged, state["iteration"], cur, new_m))
+        payload = ckpt_mod.serialize_state(state)
+        new_learner = self._learner_factory(new_m)
+        valids = [(e["data"], self.valid_metrics[i], e["name"])
+                  for i, e in enumerate(self.valid_datasets)]
+        # init() rebuilds device state but not the progress bookkeeping
+        # __init__ owns — reset it so the restore sees a fresh booster
+        # (valid sets re-add below; best_score/best_iter re-append there
+        # and are then overwritten by the restore)
+        self.models = []
+        self.iter = 0
+        self.valid_datasets = []
+        self.valid_metrics = []
+        self.best_score = []
+        self.best_iter = []
+        self.init(self.gbdt_config, self.train_data, self.objective,
+                  self.training_metrics, learner=new_learner)
+        for vd, ms, name in valids:
+            self.add_valid_dataset(vd, ms, name=name)
+        self.restore_checkpoint(payload)
+        if self._straggler_monitor is not None:
+            self._straggler_monitor.reset()
+        telemetry.count("elastic/shrinks")
+        return False
+
     def train_one_iter(self, is_eval: bool = True) -> bool:
         """GBDT::TrainOneIter (gbdt.cpp:167-214).  Returns True when
         training must stop (early stopping or no splittable leaf).
@@ -927,7 +1320,13 @@ class GBDT:
             hess = hess[None]
         entry = {"iter_no": self.iter, "is_eval": is_eval, "cls": [],
                  "grad": grad, "hess": hess, "pre_rng": pre_rng,
-                 "mon": mon}
+                 "mon": mon,
+                 # pre-dispatch score references (functional updates make
+                 # these free): the CONSUMED-boundary state a checkpoint
+                 # taken while this entry is in flight must describe
+                 "score_before": self.score,
+                 "valid_before": tuple(e["score"]
+                                       for e in self.valid_datasets)}
         g_grow, h_grow, goss_mask = self._goss_masks(grad, hess)
         lr = jnp.float32(self.gbdt_config.learning_rate)
         for cls in range(self.num_class):
@@ -1140,6 +1539,42 @@ class GBDT:
         # snapshot one iteration/chunk stale — callers who accept that
         # lag opt in with pipeline=readback explicitly).
         self._pipeline_auto = save_fn is None
+        # asynchronous periodic checkpoints (ISSUE 14): snapshots ride a
+        # background writer thread, OFF the pipelined readback path — the
+        # hot loop only pays the cheap raw snapshot (checkpoint_state);
+        # pipelining stays on, so a checkpoint describes the CONSUMED
+        # boundary (at most one iteration/chunk behind the dispatch)
+        ckpt_interval = int(getattr(self.gbdt_config,
+                                    "checkpoint_interval", 0) or 0)
+        ckpt_writer = None
+        if ckpt_interval > 0:
+            from .. import checkpoint as ckpt_mod
+            ckpt_dir = getattr(self.gbdt_config, "checkpoint_dir", "")
+            log.check(bool(ckpt_dir),
+                      "checkpoint_interval > 0 requires checkpoint_dir")
+            ckpt_writer = ckpt_mod.CheckpointWriter(
+                ckpt_dir,
+                keep=int(getattr(self.gbdt_config, "checkpoint_keep", 2)))
+            self._ckpt_writer = ckpt_writer
+            self._last_ckpt_iter = self._consumed_iteration()
+        self._boundary_t = time.perf_counter()
+
+        def _boundary() -> bool:
+            """Iteration-boundary housekeeping: enqueue the async
+            checkpoint, run the live straggler policy, and fire the
+            fault-injection hatch (faults.maybe_fire — the harness's
+            between-iterations kill/stall point).  Returns True when the
+            elastic drain surfaced a stop."""
+            if ckpt_writer is not None:
+                done = self._consumed_iteration()
+                if done - self._last_ckpt_iter >= ckpt_interval:
+                    ckpt_writer.submit(self.checkpoint_state())
+                    self._last_ckpt_iter = done
+            stop = False
+            if self._straggler_monitor is not None:
+                stop = self._elastic_step()
+            faults_mod.maybe_fire(self._consumed_iteration())
+            return stop
         try:
             if not self.chunkable_for(is_eval) or (num_iterations < chunk_size
                                                    and not self._mp_fp):
@@ -1156,6 +1591,8 @@ class GBDT:
                     if progress_fn is not None:
                         progress_fn(self.iter)
                     if finished:
+                        break
+                    if _boundary():
                         break
             else:
                 done = 0
@@ -1174,6 +1611,8 @@ class GBDT:
                         progress_fn(self.iter)
                     if stop:
                         break
+                    if _boundary():
+                        break
                     done += chunk_size
             # drain the deferred readbacks (pipelined mode; no-op
             # otherwise) so callers see fully-consistent models/scores
@@ -1185,6 +1624,10 @@ class GBDT:
                     save_fn()
                 if progress_fn is not None:
                     progress_fn(self.iter)
+            if ckpt_writer is not None:
+                # final checkpoint, synchronous: a restart after a clean
+                # finish sees the complete run
+                ckpt_writer.write_sync(self.checkpoint_state())
         except BaseException as e:
             # crash-flush (ISSUE 4): an exception escaping training —
             # TrainingHealthError halts included — must not lose the
@@ -1209,6 +1652,16 @@ class GBDT:
             finally:
                 self._pipe = None
                 self._pipe_chunk = None
+            if ckpt_writer is not None:
+                # best-effort final checkpoint: a clean exception
+                # (TrainingHealthError halt, injected raise) leaves the
+                # consumed state consistent and restartable; if the state
+                # is torn, the write fails quietly and the last periodic
+                # checkpoint stands
+                try:
+                    ckpt_writer.write_sync(self.checkpoint_state())
+                except Exception:
+                    pass
             if telemetry.sink_active():
                 try:
                     extra = {"aborted": type(e).__name__,
@@ -1221,6 +1674,11 @@ class GBDT:
             raise
         finally:
             self._pipeline_auto = False
+            if ckpt_writer is not None:
+                ckpt_writer.close()
+                self._ckpt_stats = {"written": ckpt_writer.written,
+                                    "dropped": ckpt_writer.dropped}
+                self._ckpt_writer = None
             if wd_armed:
                 telemetry.disarm_watchdog()
         if self._host_inputs:
@@ -1821,45 +2279,18 @@ class GBDT:
         kept = self.models[len(self.models) - kept_trees:] \
             if kept_trees > 0 else []
         max_nodes = max(_effective_num_leaves(self.tree_config) - 1, 1)
-
-        def replay(score, bins, tree, cls_m, feat_map=None):
-            # ``feat_map``: canonical inner feature -> row of ``bins``;
-            # the TRAIN matrix is in packed (mixed-bin) feature order
-            # while tree.split_feature is canonical, valid matrices are
-            # canonical
-            pad = lambda a: np.pad(np.asarray(a), (0, max_nodes - len(a)))
-            sf = np.asarray(tree.split_feature)
-            if feat_map is not None and len(sf):
-                sf = feat_map[sf]
-            leaf_vals = np.zeros(max_nodes + 1, np.float32)
-            leaf_vals[:tree.num_leaves] = tree.leaf_value
-            new_cls = add_tree_score(
-                bins, score[cls_m],
-                pad(sf),
-                pad(tree.threshold_bin),
-                pad(tree.left_child),
-                pad(tree.right_child),
-                leaf_vals,
-                np.int32(tree.num_leaves),
-                max_nodes=max_nodes)
-            if isinstance(score, np.ndarray):
-                # multi-process valid scores stay host-side numpy
-                score = score.copy()
-                score[cls_m] = np.asarray(new_cls)
-                return score
-            return score.at[cls_m].set(new_cls)
-
-        score = score_before
-        vscores = list(valid_before)
         train_fmap = (np.asarray(self._pack_spec.c2p, np.int32)
                       if getattr(self, "_pack_spec", None) is not None
                       else None)
+        score = score_before
+        vscores = list(valid_before)
         for m, tree in enumerate(kept):
             cls_m = m % C
-            score = replay(score, self.bins_device, tree, cls_m,
-                           feat_map=train_fmap)
+            score = _replay_tree(score, self.bins_device, tree, cls_m,
+                                 max_nodes, feat_map=train_fmap)
             for v, entry in enumerate(self.valid_datasets):
-                vscores[v] = replay(vscores[v], entry["bins"], tree, cls_m)
+                vscores[v] = _replay_tree(vscores[v], entry["bins"], tree,
+                                          cls_m, max_nodes)
         self.score = score
         for entry, s in zip(self.valid_datasets, vscores):
             entry["score"] = s
@@ -1889,19 +2320,23 @@ class GBDT:
 
     # --------------------------------------------------------------- metrics
 
-    def _host_global_score(self) -> np.ndarray:
+    def _host_global_score(self, score=None) -> np.ndarray:
         """Training score as a host [C, N_true] array.  Multi-process mode
         replicates the row-sharded global score across the mesh (one
-        all_gather) and compacts out the per-process padding blocks."""
+        all_gather) and compacts out the per-process padding blocks.
+        ``score`` defaults to the live array (checkpoint_state passes the
+        consumed-boundary reference)."""
+        if score is None:
+            score = self.score
         if not self._mp:
-            return np.asarray(self.score)
+            return np.asarray(score)
         prog = getattr(self, "_mp_replicate_prog", None)
         if prog is None:
             from jax.sharding import NamedSharding, PartitionSpec
             prog = self._mp_replicate_prog = jax.jit(
                 lambda s: s,
                 out_shardings=NamedSharding(self._mp_mesh, PartitionSpec()))
-        full = np.asarray(prog(self.score))
+        full = np.asarray(prog(score))
         return np.concatenate([full[:, s:s + ln]
                                for s, ln in self._shard_layout], axis=1)
 
@@ -2470,6 +2905,44 @@ def _serial_learner(gbdt: GBDT, bins, grad, hess, row_mask, feature_mask):
     return grow_tree(
         bins, grad, hess, row_mask, feature_mask, gbdt.num_bins_device,
         **kwargs)
+
+
+def _replay_tree(score, bins, tree, cls_m: int, max_nodes: int,
+                 feat_map=None):
+    """Apply one host tree's score contribution to class ``cls_m`` of a
+    [C, N] score by replaying the split sequence on the binned matrix —
+    the chunk rollback's rebuild rule, factored out of
+    ``_rollback_chunk``.  NOT bitwise-equal to the in-grow f32 update:
+    the host tree's shrunk leaf values went through an f64
+    learning-rate product, which can round 1 ulp away from the device's
+    f32 product — both rollback sides share this path, so the rollback
+    equivalence pins hold; checkpoints store raw scores instead
+    (lightgbm_tpu/checkpoint.py).
+
+    ``feat_map``: canonical inner feature -> row of ``bins``; the TRAIN
+    matrix is in packed (mixed-bin) feature order while
+    ``tree.split_feature`` is canonical, valid matrices are canonical."""
+    pad = lambda a: np.pad(np.asarray(a), (0, max_nodes - len(a)))
+    sf = np.asarray(tree.split_feature)
+    if feat_map is not None and len(sf):
+        sf = feat_map[sf]
+    leaf_vals = np.zeros(max_nodes + 1, np.float32)
+    leaf_vals[:tree.num_leaves] = tree.leaf_value
+    new_cls = add_tree_score(
+        bins, score[cls_m],
+        pad(sf),
+        pad(tree.threshold_bin),
+        pad(tree.left_child),
+        pad(tree.right_child),
+        leaf_vals,
+        np.int32(tree.num_leaves),
+        max_nodes=max_nodes)
+    if isinstance(score, np.ndarray):
+        # multi-process valid scores stay host-side numpy
+        score = score.copy()
+        score[cls_m] = np.asarray(new_cls)
+        return score
+    return score.at[cls_m].set(new_cls)
 
 
 def _effective_num_leaves(tree_config) -> int:

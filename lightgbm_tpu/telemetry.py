@@ -144,6 +144,26 @@ ISSUE 8 — streaming ingestion (io/streaming.py):
    RNG + full-N upload) and the ``goss/iterations`` counter under a
    ``goss`` span.  scripts/telemetry_report.py renders the family with
    derived H2D GB/s.
+
+ISSUE 14 — preemption-safe elastic training (checkpoint.py, elastic.py):
+
+10. **Checkpoint counters (``ckpt/*``)**: ``ckpt/snapshots`` (raw
+    snapshots enqueued at iteration boundaries), ``ckpt/written``
+    (atomic files landed — async AND sync), ``ckpt/dropped`` (a pending
+    snapshot replaced by a newer one before the writer thread got to it
+    — latest-wins backpressure, never a training stall),
+    ``ckpt/async_write_us`` (cumulative writer-thread serialize+write
+    time, all OFF the hot loop), ``ckpt/pruned`` (old files removed
+    past ``checkpoint_keep``), ``ckpt/restored`` (restores executed).
+
+11. **Elastic span + wire sites**: the per-iteration cross-host time
+    exchange and the mesh-shrink survivor agreement run under an
+    ``elastic`` span and file the ``elastic/times_allgather``
+    (all_gather of per-host iteration seconds over the ``data`` axis)
+    and ``elastic/survivor_pmin`` (elementwise keep/drop vote minimum)
+    collective sites — both censused by graftlint J2
+    (analysis/programs.elastic_programs).  ``elastic/shrinks`` counts
+    executed drain-at-boundary mesh shrinks.
 """
 from __future__ import annotations
 

@@ -22,6 +22,12 @@ round against the earlier trajectory:
   ``frac_of_peak_bw`` per phase, when present — a throughput number can
   hide a kernel regression behind a faster host, the attained fraction
   cannot;
+- **checkpoint contracts** (ISSUE 14): ``ckpt_overhead_pct`` (the
+  bench_ckpt lane's checkpointing-on vs off slowdown) rides the
+  must-not-grow latency lane, and ``ckpt_restore_exact`` recorded False
+  on ANY round — a same-topology restore that was not bit-identical —
+  is an absolute finding, as are ``restore_match``/``metrics_complete``
+  False in a multichip round's ``MULTICHIP_ELASTIC`` kill-restart row;
 - **multichip**: a round whose smoke run went ok -> not-ok, plus the
   ISSUE-5 distributed-observability trajectory: the ``skew`` block's
   ``max_phase_skew`` (cross-host per-phase dispersion must not grow
@@ -113,6 +119,11 @@ RATE_KEYS: Tuple[Tuple[str, str], ...] = (
 # the request path — not percent drift.
 LATENCY_KEYS: Tuple[Tuple[str, str], ...] = (
     ("serve_p99_us", "serve_spread"),
+    # checkpoint cost (ISSUE 14, bench.py --bench-ckpt): percent slowdown
+    # of the training loop with async checkpointing ON vs OFF.  Lower is
+    # better; gated must-not-grow at the wide observability floor (the
+    # overhead is a small difference of two noisy wall times).
+    ("ckpt_overhead_pct", "ckpt_spread"),
 )
 
 # absolute zero-tolerance keys (no trajectory needed): any nonzero on
@@ -132,6 +143,17 @@ ABSOLUTE_ZERO_KEYS: Tuple[Tuple[str, str], ...] = (
     ("serve_misscored",
      "request(s) misscored across the mid-load hot swap (a result "
      "matched neither the old nor the new engine — a torn swap)"),
+)
+
+# absolute must-be-true keys (ISSUE 14): a recorded value of exactly
+# False on ANY round in the trajectory is a finding — these are
+# correctness contracts, not trajectories.  Absent keys (older rounds)
+# are fine.
+ABSOLUTE_TRUE_KEYS: Tuple[Tuple[str, str], ...] = (
+    ("ckpt_restore_exact",
+     "a checkpoint restore was not bit-identical on the same topology "
+     "(model text / scores / RNG streams diverged from the "
+     "uninterrupted run)"),
 )
 
 DEFAULT_FLOOR = 0.02      # minimum relative noise band when none recorded
@@ -212,6 +234,21 @@ def _attach_multichip_obs(rec: dict) -> None:
             if isinstance(wire, dict):
                 rec["wire"] = wire
             break
+    if "elastic" not in rec:
+        # ISSUE 14: the kill-a-process-mid-run row prints one
+        # MULTICHIP_ELASTIC JSON line (SIGKILL between iterations →
+        # restart from the latest checkpoint on a shrunk topology)
+        for line in reversed(lines):
+            line = line.strip()
+            if not line.startswith("MULTICHIP_ELASTIC "):
+                continue
+            try:
+                el = json.loads(line[len("MULTICHIP_ELASTIC "):])
+            except ValueError:
+                break
+            if isinstance(el, dict):
+                rec["elastic"] = el
+            break
 
 
 def _fractions(rec: dict) -> Dict[str, float]:
@@ -276,6 +313,18 @@ def _check_group(metric: str, entries: List[dict], floor: float,
                 "latest": v, "baseline": 0,
                 "detail": detail,
             })
+    # must-be-true contracts (ISSUE 14): checked on EVERY recorded round
+    # — a round that recorded a non-bit-identical checkpoint restore is
+    # a finding forever, not only while it is the latest
+    for akey, detail in ABSOLUTE_TRUE_KEYS:
+        for e in entries:
+            if e["rec"].get(akey) is False:
+                findings.append({
+                    "metric": metric, "key": akey,
+                    "latest_round": e["round"],
+                    "latest": False, "baseline": True,
+                    "detail": detail,
+                })
     _check_mixedbin_resolution(metric, entries[-1], findings)
     if len(entries) < 2:
         return
@@ -394,6 +443,30 @@ def _check_multichip(entries: List[dict], findings: List[dict],
                      floor: float = DEFAULT_FLOOR,
                      sigma_mult: float = DEFAULT_SIGMA_MULT) -> None:
     entries = sorted(entries, key=lambda e: e["round"])
+    # ISSUE 14 absolute contracts on the kill-restart row, checked on
+    # every round that recorded one: a restore that lost finished trees
+    # or metric records, or that diverged from the uninterrupted run's
+    # budget class, must not pass the gate
+    for e in entries:
+        el = e["rec"].get("elastic")
+        if not isinstance(el, dict):
+            continue
+        for akey, detail in (
+                ("restore_match",
+                 "the restarted run's final model diverged from the "
+                 "uninterrupted reference beyond the documented budget "
+                 "class"),
+                ("metrics_complete",
+                 "iteration/metric records were lost across the "
+                 "kill-restart (coverage of the iteration range has "
+                 "gaps)")):
+            if el.get(akey) is False:
+                findings.append({
+                    "metric": "multichip", "key": "elastic/" + akey,
+                    "latest_round": e["round"],
+                    "latest": False, "baseline": True,
+                    "detail": detail,
+                })
     if len(entries) < 2:
         return
     latest = entries[-1]

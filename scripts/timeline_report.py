@@ -45,10 +45,21 @@ from __future__ import annotations
 import argparse
 import glob as globmod
 import json
+import os
 import sys
 from typing import Dict, List, Optional, Tuple
 
-CANONICAL_PHASES = ("histogram", "split_find", "partition", "eval")
+# the skew/straggler logic is SHARED with the trainer's live mesh-shrink
+# policy (ISSUE 14): one implementation, lightgbm_tpu/elastic.py — this
+# script merges shards into the row shape and delegates.  Importing the
+# package may initialize jax; keep it on the CPU platform like the other
+# analysis scripts.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+from lightgbm_tpu import elastic  # noqa: E402
+
+CANONICAL_PHASES = elastic.CANONICAL_PHASES
 
 
 class ReportError(Exception):
@@ -135,76 +146,15 @@ def _phase_rows(shards: List[dict]) -> Dict[int, Dict[str, Dict[str, float]]]:
     return rows
 
 
-def _median(vals: List[float]) -> float:
-    s = sorted(vals)
-    n = len(s)
-    return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
-
-
 def skew_report(shards: List[dict], straggler_k: int = 3) -> dict:
     """Per-phase cross-host skew + barrier-wait decomposition + the
     persistent-straggler flag.  Needs ≥2 shards with overlapping
-    iteration records; degrades to an empty report otherwise."""
-    rows = _phase_rows(shards)
-    multi = {it: hosts for it, hosts in rows.items() if len(hosts) >= 2}
-    phases: Dict[str, dict] = {}
-    barrier_wait: Dict[str, float] = {}
-    slowest_seq: List[Tuple[int, Optional[str]]] = []
-    for it in sorted(multi):
-        hosts = multi[it]
-        # compare every phase any host recorded this iteration — the
-        # per-iteration path's host phases (grow/gradient/...) live
-        # beside the canonical keys
-        it_phases = sorted({p for pt in hosts.values() for p in pt})
-        totals = {h: sum(pt.values()) for h, pt in hosts.items()}
-        t_max = max(totals.values())
-        slowest = max(totals, key=lambda h: totals[h])
-        # a tie is not a straggler: count a slowest host only when it is
-        # STRICTLY slower than every peer this iteration
-        unique = sum(1 for v in totals.values() if v == t_max) == 1
-        slowest_seq.append((it, slowest if t_max > 0 and unique else None))
-        for h, tot in totals.items():
-            # time this host spends idle at the collectives waiting for
-            # the slowest peer of the iteration
-            barrier_wait[h] = barrier_wait.get(h, 0.0) + (t_max - tot)
-        for p in it_phases:
-            vals = [pt.get(p, 0.0) for pt in hosts.values()]
-            med = _median(vals)
-            if med <= 0:
-                continue
-            ratio = max(vals) / med
-            blk = phases.setdefault(p, {"max_skew": 0.0, "ratios": []})
-            blk["max_skew"] = max(blk["max_skew"], ratio)
-            blk["ratios"].append(ratio)
-    for p, blk in phases.items():
-        blk["mean_skew"] = round(sum(blk["ratios"]) / len(blk["ratios"]), 4)
-        blk["iterations"] = len(blk.pop("ratios"))
-        blk["max_skew"] = round(blk["max_skew"], 4)
-    # persistent straggler: same host slowest >= K consecutive ITERATION
-    # NUMBERS — a gap in the compared iterations (truncated shard tail,
-    # single-host records) resets the run rather than bridging it
-    straggler = None
-    run_host, run_len, prev_it = None, 0, None
-    for it, host in slowest_seq:
-        if (host is not None and host == run_host
-                and prev_it is not None and it == prev_it + 1):
-            run_len += 1
-        else:
-            run_host, run_len = host, 1
-        prev_it = it
-        if run_host is not None and run_len >= straggler_k:
-            straggler = run_host
-    out = {
-        "iterations_compared": len(multi),
-        "hosts": sorted({h for hosts in multi.values() for h in hosts}),
-        "phases": phases,
-        "max_phase_skew": round(max(
-            [b["max_skew"] for b in phases.values()] or [0.0]), 4),
-        "barrier_wait_s": {h: round(v, 6)
-                          for h, v in sorted(barrier_wait.items())},
-        "straggler_k": straggler_k,
-        "persistent_straggler": straggler,
-    }
+    iteration records; degrades to an empty report otherwise.  The
+    computation itself is ``lightgbm_tpu.elastic.skew_from_rows`` — the
+    SAME implementation the trainer's live mesh-shrink policy consumes,
+    so post-mortem and live verdicts can never diverge."""
+    out = elastic.skew_from_rows(_phase_rows(shards),
+                                 straggler_k=straggler_k)
     wire = _wire_decomposition(shards)
     if wire:
         out["wire"] = wire

@@ -57,6 +57,21 @@ def _telemetry_leak_guard():
     leaked_census = _graftlint_census.trace_census_active()
     if leaked_census:
         _graftlint_census.end_census()
+    # ISSUE 14 surfaces: a live async checkpoint writer keeps writing
+    # into a (possibly torn-down) tmpdir after the test ends; an armed
+    # fault-injection hatch (programmatic or env) would kill/stall a
+    # LATER test's training loop at its configured iteration.  Check,
+    # then clean up so the rest of the suite runs unpoisoned.
+    from lightgbm_tpu import checkpoint as _ckpt_mod
+    from lightgbm_tpu import faults as _faults_mod
+    leaked_ckpt_writers = _ckpt_mod.live_writers()
+    if leaked_ckpt_writers:
+        for w in list(_ckpt_mod._LIVE_WRITERS):
+            w.close()
+    leaked_fault = _faults_mod.armed()
+    if leaked_fault:
+        _faults_mod.disarm()
+        os.environ.pop(_faults_mod.ENV_VAR, None)
     telemetry.disable()
     telemetry.reset()
     # ISSUE 9 surface: a test that enters ``with mesh:`` and leaks it
@@ -77,13 +92,17 @@ def _telemetry_leak_guard():
         pass
     assert not (leaked_enabled or leaked_sink or leaked_watchdog
                 or leaked_timeline or leaked_census
+                or leaked_ckpt_writers or leaked_fault
                 or leaked_mesh is not None), (
         "test left %s — clean up (telemetry.disable() / end_census() / "
-        "exit the mesh context, or use a fixture) so state cannot leak "
-        "between tests"
+        "CheckpointWriter.close() / faults.disarm() / exit the mesh "
+        "context, or use a fixture) so state cannot leak between tests"
         % ("telemetry with a live watchdog thread" if leaked_watchdog
            else "telemetry in timeline/shard mode" if leaked_timeline
            else "graftlint trace-census armed" if leaked_census
+           else "%d checkpoint writer thread(s) alive"
+                % leaked_ckpt_writers if leaked_ckpt_writers
+           else "a fault-injection hatch armed" if leaked_fault
            else "telemetry enabled with an open sink" if leaked_sink
            else "telemetry enabled" if leaked_enabled
            else "a global mesh context installed (%r)" % (leaked_mesh,)))

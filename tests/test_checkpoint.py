@@ -1,0 +1,329 @@
+"""Preemption-safe checkpoints (ISSUE 14, lightgbm_tpu/checkpoint.py).
+
+Pins the tentpole contracts: a restart from a checkpoint continues
+BIT-IDENTICALLY on the same topology (model text, scores, RNG streams —
+per-iteration AND fused-chunk paths, f32 and int8), the file format
+rejects truncation/corruption/config-mismatch with a precise error
+naming the field, the write discipline is atomic (a crash mid-write
+leaves the previous checkpoint loadable), and the asynchronous writer
+rides off the hot loop and never outlives run_training (the conftest
+leak guard enforces the latter suite-wide)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu import checkpoint as ckpt
+from lightgbm_tpu.config import OverallConfig
+from lightgbm_tpu.io.dataset import Dataset
+from lightgbm_tpu.models.gbdt import GBDT
+from lightgbm_tpu.objectives import create_objective
+from lightgbm_tpu.utils import log
+
+
+@pytest.fixture()
+def data():
+    rng = np.random.RandomState(7)
+    x = rng.randn(1200, 10)
+    y = (x[:, 0] - x[:, 1] + 0.4 * rng.randn(1200) > 0).astype(np.float32)
+    return x, y
+
+
+BASE = {"objective": "binary", "num_leaves": "8", "min_data_in_leaf": "5",
+        "min_sum_hessian_in_leaf": "0.1", "learning_rate": "0.1",
+        "verbose": "-1"}
+
+
+def make_booster(x, y, extra=None, valid=None, metrics=()):
+    params = dict(BASE)
+    if extra:
+        params.update(extra)
+    cfg = OverallConfig()
+    cfg.set(params, require_data=False)
+    ds = Dataset.from_arrays(x, y, max_bin=63)
+    b = GBDT()
+    b.init(cfg.boosting_config, ds,
+           create_objective(cfg.objective_type, cfg.objective_config),
+           list(metrics))
+    if valid is not None:
+        vx, vy, vmetrics = valid
+        vds = Dataset.from_arrays(vx, vy, max_bin=63)
+        b.add_valid_dataset(vds, list(vmetrics))
+    return b
+
+
+def fingerprint(b):
+    return ([t.to_string() for t in b.models], np.asarray(b.score))
+
+
+@pytest.mark.parametrize("extra", [
+    {},                                                    # f32 leafwise
+    {"hist_dtype": "int8"},                                # int8 leafwise
+    {"grow_policy": "depthwise"},                          # fused chunk f32
+    {"grow_policy": "depthwise", "hist_dtype": "int8"},    # fused chunk int8
+    {"bagging_fraction": "0.8", "bagging_freq": "2",       # RNG streams
+     "feature_fraction": "0.8"},
+], ids=["f32", "int8", "chunk_f32", "chunk_int8", "bagging_ff"])
+def test_same_topology_restore_bit_identical(data, tmp_path, extra):
+    """train(8) == train(4) -> checkpoint -> fresh booster -> restore ->
+    train(4): model text AND scores bitwise, through a real file."""
+    x, y = data
+    a = make_booster(x, y, extra)
+    a.run_training(8, is_eval=False, chunk_size=4)
+    trees_a, score_a = fingerprint(a)
+
+    b = make_booster(x, y, extra)
+    b.run_training(4, is_eval=False, chunk_size=4)
+    path = ckpt.write_checkpoint(str(tmp_path),
+                                 ckpt.serialize_state(b.checkpoint_state()))
+    c = make_booster(x, y, extra)
+    c.restore_checkpoint(str(path))
+    assert c.iter == 4 and len(c.models) == 4
+    c.run_training(4, is_eval=False, chunk_size=4)
+
+    trees_c, score_c = fingerprint(c)
+    assert trees_a == trees_c
+    np.testing.assert_array_equal(score_a, score_c)
+
+
+def test_restore_preserves_early_stopping_state(data, tmp_path):
+    """best_score/best_iter and valid scores survive the round trip:
+    resumed training makes the same early-stopping decisions."""
+    from lightgbm_tpu.metrics import create_metric
+    x, y = data
+    vx, vy = x[:300], y[:300]
+
+    def make():
+        cfg = OverallConfig()
+        params = dict(BASE)
+        params.update({"metric": "auc", "early_stopping_round": "50"})
+        cfg.set(params, require_data=False)
+        ds = Dataset.from_arrays(x[300:], y[300:], max_bin=63)
+        vds = Dataset.from_arrays(vx, vy, max_bin=63)
+        b = GBDT()
+        b.init(cfg.boosting_config, ds,
+               create_objective(cfg.objective_type, cfg.objective_config))
+        b.add_valid_dataset(vds, [create_metric("auc", cfg.metric_config)])
+        return b
+
+    a = make()
+    a.run_training(8, is_eval=True)
+    b = make()
+    b.run_training(4, is_eval=True)
+    payload = ckpt.serialize_state(b.checkpoint_state())
+    c = make()
+    c.restore_checkpoint(json.loads(json.dumps(payload)))
+    assert c.best_score == b.best_score
+    assert c.best_iter == b.best_iter
+    np.testing.assert_array_equal(
+        np.asarray(c.valid_datasets[0]["score"]),
+        np.asarray(b.valid_datasets[0]["score"]))
+    c.run_training(4, is_eval=True)
+    assert [t.to_string() for t in c.models] == \
+        [t.to_string() for t in a.models]
+    assert c.best_score == a.best_score
+    assert c.best_iter == a.best_iter
+
+
+def test_pipelined_checkpoint_describes_consumed_boundary(data):
+    """With an iteration in flight (pipeline=readback), checkpoint_state
+    snapshots the CONSUMED boundary — restoring it and retraining the
+    tail reproduces the uninterrupted run exactly."""
+    x, y = data
+    a = make_booster(x, y)
+    a.run_training(6, is_eval=False)
+    trees_a, score_a = fingerprint(a)
+
+    b = make_booster(x, y, {"pipeline": "readback"})
+    for _ in range(3):
+        b.train_one_iter(is_eval=False)
+    # iteration 3 dispatched, 2 consumed: the snapshot must say 2
+    assert b._pipe is not None
+    state = b.checkpoint_state()
+    assert state["iteration"] == 2
+    assert len(state["models"]) == 2
+    payload = ckpt.serialize_state(state)
+    assert b.flush_pipeline() is False
+
+    c = make_booster(x, y)
+    c.restore_checkpoint(payload)
+    c.run_training(4, is_eval=False)
+    trees_c, score_c = fingerprint(c)
+    assert trees_a == trees_c
+    np.testing.assert_array_equal(score_a, score_c)
+
+
+def test_run_training_async_writer_lifecycle(data, tmp_path):
+    """checkpoint_interval= writes atomic files on the background writer,
+    prunes to checkpoint_keep, writes a final sync checkpoint, and
+    closes the writer (live_writers() == 0 afterwards — also enforced by
+    the conftest leak guard)."""
+    x, y = data
+    cdir = str(tmp_path / "ck")
+    b = make_booster(x, y, {"checkpoint_interval": "2",
+                            "checkpoint_dir": cdir,
+                            "checkpoint_keep": "2"})
+    b.run_training(6, is_eval=False)
+    assert ckpt.live_writers() == 0
+    files = ckpt.list_checkpoints(cdir)
+    assert 1 <= len(files) <= 2          # pruned to keep=2
+    latest = ckpt.latest_checkpoint(cdir)
+    payload = ckpt.load_checkpoint(latest)
+    assert payload["iteration"] == 6     # the final sync checkpoint
+    assert len(payload["trees"]) == 6
+
+    c = make_booster(x, y)
+    c.restore_checkpoint(payload)
+    assert fingerprint(c)[0] == fingerprint(b)[0]
+    np.testing.assert_array_equal(np.asarray(c.score), np.asarray(b.score))
+
+
+def test_no_interval_no_writer(data, tmp_path):
+    x, y = data
+    b = make_booster(x, y)
+    b.run_training(2, is_eval=False)
+    assert ckpt.live_writers() == 0
+    assert ckpt.list_checkpoints(str(tmp_path)) == []
+
+
+def _valid_checkpoint(data, tmp_path):
+    x, y = data
+    b = make_booster(x, y)
+    b.run_training(3, is_eval=False)
+    path = ckpt.write_checkpoint(
+        str(tmp_path), ckpt.serialize_state(b.checkpoint_state()))
+    return b, path
+
+
+def test_truncated_checkpoint_rejected(data, tmp_path):
+    _, path = _valid_checkpoint(data, tmp_path)
+    blob = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(blob[:len(blob) // 2])
+    with pytest.raises(ckpt.CheckpointError, match="truncated"):
+        ckpt.load_checkpoint(path)
+
+
+def test_corrupt_checkpoint_rejected(data, tmp_path):
+    _, path = _valid_checkpoint(data, tmp_path)
+    blob = bytearray(open(path, "rb").read())
+    blob[-20] ^= 0x41
+    with open(path, "wb") as f:
+        f.write(bytes(blob))
+    with pytest.raises(ckpt.CheckpointError, match="sha256"):
+        ckpt.load_checkpoint(path)
+
+
+def test_bad_header_rejected(tmp_path):
+    path = str(tmp_path / "ckpt-00000001.json")
+    with open(path, "w") as f:
+        f.write("not a checkpoint at all\n{}")
+    with pytest.raises(ckpt.CheckpointError, match="header"):
+        ckpt.load_checkpoint(path)
+
+
+def test_missing_field_named(data, tmp_path):
+    """A structurally valid file missing a payload field names the field
+    in the error — not a KeyError three layers down."""
+    b, path = _valid_checkpoint(data, tmp_path)
+    payload = ckpt.load_checkpoint(path)
+    for field in ("rng", "trees", "score", "config"):
+        broken = {k: v for k, v in payload.items() if k != field}
+        p2 = ckpt.write_checkpoint(str(tmp_path / ("f_" + field)), broken)
+        with pytest.raises(ckpt.CheckpointError, match="'%s'" % field):
+            ckpt.load_checkpoint(p2)
+
+
+def test_config_mismatch_names_field(data, tmp_path):
+    x, y = data
+    _, path = _valid_checkpoint(data, tmp_path)
+    payload = ckpt.load_checkpoint(path)
+    c = make_booster(x, y, {"num_leaves": "16"})
+    with pytest.raises(log.LightGBMError, match="num_leaves"):
+        c.restore_checkpoint(payload)
+    d = make_booster(x, y, {"learning_rate": "0.2"})
+    with pytest.raises(log.LightGBMError, match="learning_rate"):
+        d.restore_checkpoint(payload)
+
+
+def test_dataset_mismatch_names_field(data, tmp_path):
+    x, y = data
+    _, path = _valid_checkpoint(data, tmp_path)
+    payload = ckpt.load_checkpoint(path)
+    e = make_booster(x[:800], y[:800])
+    with pytest.raises(log.LightGBMError, match="num_rows"):
+        e.restore_checkpoint(payload)
+
+
+def test_restore_requires_fresh_booster(data, tmp_path):
+    x, y = data
+    _, path = _valid_checkpoint(data, tmp_path)
+    payload = ckpt.load_checkpoint(path)
+    c = make_booster(x, y)
+    c.restore_checkpoint(payload)
+    with pytest.raises(log.LightGBMError, match="freshly initialized"):
+        c.restore_checkpoint(payload)
+
+
+def test_atomic_rename_discipline(data, tmp_path):
+    """A crash mid-write leaves (a) the previous checkpoint loadable and
+    (b) only a stray .tmp-* file the loader/lister ignore."""
+    _, path = _valid_checkpoint(data, tmp_path)
+    # simulate a writer killed mid-write: a partial temp file appears
+    stray = str(tmp_path / ".tmp-9999-1")
+    with open(stray, "w") as f:
+        f.write("lightgbm_tpu_checkpoint v1 sha256=" + "0" * 64
+                + " bytes=99999\n{\"partial")
+    assert ckpt.list_checkpoints(str(tmp_path)) == [path]
+    assert ckpt.latest_checkpoint(str(tmp_path)) == path
+    payload = ckpt.load_checkpoint(path)     # previous still loadable
+    assert payload["iteration"] == 3
+
+
+def test_latest_checkpoint_orders_by_iteration(tmp_path):
+    for it in (3, 12, 7):
+        p = str(tmp_path / ("ckpt-%08d.json" % it))
+        with open(p, "w") as f:
+            f.write("x")
+    assert ckpt.latest_checkpoint(str(tmp_path)).endswith(
+        "ckpt-00000012.json")
+
+
+def test_writer_latest_wins_and_close(tmp_path, data):
+    """Backpressure contract: submit never blocks; a pending snapshot is
+    replaced by a newer one (counted as dropped), and close drains."""
+    x, y = data
+    b = make_booster(x, y)
+    b.run_training(2, is_eval=False)
+    w = ckpt.CheckpointWriter(str(tmp_path), keep=5)
+    try:
+        for _ in range(5):
+            w.submit(b.checkpoint_state())
+    finally:
+        w.close()
+    assert not w.alive
+    assert ckpt.live_writers() == 0
+    assert w.written >= 1
+    assert w.written + w.dropped == 5
+    assert ckpt.latest_checkpoint(str(tmp_path)) is not None
+
+
+def test_config_knob_rejects():
+    cfg = OverallConfig()
+    with pytest.raises(log.LightGBMError, match="checkpoint_dir"):
+        cfg.set({"objective": "binary", "checkpoint_interval": "4"},
+                require_data=False)
+    cfg2 = OverallConfig()
+    with pytest.raises(log.LightGBMError, match="checkpoint_keep"):
+        cfg2.set({"objective": "binary", "checkpoint_interval": "4",
+                  "checkpoint_dir": "/tmp/x", "checkpoint_keep": "0"},
+                 require_data=False)
+    cfg3 = OverallConfig()
+    with pytest.raises(log.LightGBMError, match="straggler_k"):
+        cfg3.set({"objective": "binary", "straggler_k": "0"},
+                 require_data=False)
+    cfg4 = OverallConfig()
+    with pytest.raises(log.LightGBMError, match="elastic_shrink"):
+        cfg4.set({"objective": "binary", "elastic_shrink": "true"},
+                 require_data=False)

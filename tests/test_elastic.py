@@ -1,0 +1,354 @@
+"""Elastic training (ISSUE 14): the shared straggler logic, the elastic
+mesh collectives, topology-elastic checkpoint restore, the live
+drain-at-boundary mesh shrink, and the fault-injection hatch."""
+import importlib.util
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu import checkpoint as ckpt
+from lightgbm_tpu import elastic, faults, telemetry
+from lightgbm_tpu.config import OverallConfig
+from lightgbm_tpu.io.dataset import Dataset
+from lightgbm_tpu.models.gbdt import GBDT
+from lightgbm_tpu.objectives import create_objective
+from lightgbm_tpu.parallel import create_parallel_learner
+from lightgbm_tpu.utils import log
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------ shared straggler logic
+
+def test_straggler_tracker_run_length_and_ties():
+    t = elastic.StragglerTracker(3)
+    assert t.update(1, "p1") is None
+    assert t.update(2, "p1") is None
+    assert t.update(3, "p1") == "p1"        # 3 consecutive -> flagged
+    t2 = elastic.StragglerTracker(3)
+    t2.update(1, "p1")
+    t2.update(2, None)                      # a tie resets the run
+    t2.update(3, "p1")
+    assert t2.update(4, "p1") is None
+    assert t2.flagged is None
+
+
+def test_straggler_tracker_gap_resets():
+    t = elastic.StragglerTracker(2)
+    t.update(1, "p0")
+    assert t.update(3, "p0") is None        # iteration gap: no bridge
+    assert t.update(4, "p0") == "p0"
+
+
+def test_slowest_unique_semantics():
+    assert elastic.slowest_unique({"a": 1.0, "b": 2.0}) == "b"
+    assert elastic.slowest_unique({"a": 2.0, "b": 2.0}) is None
+    assert elastic.slowest_unique({"a": 0.0, "b": 0.0}) is None
+    assert elastic.slowest_unique({}) is None
+
+
+def test_monitor_flags_on_chunk_boundaries():
+    """The live monitor is fed once per iteration BOUNDARY — once per
+    CHUNK on the fused path, where raw iteration numbers jump by
+    chunk_size.  Consecutive OBSERVATIONS must count (the monitor feeds
+    the tracker its own counter); raw-iteration gap-reset semantics stay
+    in skew_from_rows for the post-mortem rows."""
+    mon = elastic.StragglerMonitor(k=3)
+    for it in (8, 16, 24):                  # chunk_size=8 boundaries
+        mon.observe(it, {"p0": 1.0, "p1": 9.0})
+    assert mon.take_flagged() == "p1"
+
+
+def test_monitor_take_flagged_consumes_and_resets():
+    mon = elastic.StragglerMonitor(k=2)
+    mon.observe(1, {"p0": 1.0, "p1": 3.0})
+    assert mon.take_flagged() is None
+    mon.observe(2, {"p0": 1.0, "p1": 3.0})
+    assert mon.take_flagged() == "p1"
+    # consumed: the run-length state reset for the new topology
+    assert mon.take_flagged() is None
+    mon.observe(3, {"p0": 1.0, "p1": 3.0})
+    assert mon.take_flagged() is None       # needs k fresh iterations
+
+
+def test_skew_from_rows_is_the_script_implementation(tmp_path):
+    """timeline_report.skew_report delegates to elastic.skew_from_rows:
+    identical rows produce the identical verdict through both entries."""
+    spec = importlib.util.spec_from_file_location(
+        "timeline_report",
+        os.path.join(REPO, "scripts", "timeline_report.py"))
+    tr = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tr)
+
+    rows = {it: {"p0": {"histogram": 0.1, "eval": 0.02},
+                 "p1": {"histogram": 0.5, "eval": 0.02}}
+            for it in range(1, 5)}
+    direct = elastic.skew_from_rows(rows, straggler_k=3)
+    assert direct["persistent_straggler"] == "p1"
+    assert direct["iterations_compared"] == 4
+    assert direct["phases"]["histogram"]["max_skew"] == pytest.approx(
+        0.5 / 0.3, abs=1e-3)
+
+    shards = []
+    for idx, host in enumerate(("p0", "p1")):
+        path = str(tmp_path / ("s%d.jsonl" % idx))
+        with open(path, "w") as f:
+            f.write(json.dumps({"shard": {"process_index": idx,
+                                          "process_count": 2,
+                                          "clock_offset_s": 0.0,
+                                          "host": "vm"}}) + "\n")
+            for it in range(1, 5):
+                f.write(json.dumps({
+                    "iter": it, "t": float(it),
+                    "phase_times": rows[it][host]}) + "\n")
+        shards.append(tr.load_shard(path))
+    via_script = tr.skew_report(shards, straggler_k=3)
+    assert via_script["persistent_straggler"] == "p1@vm"
+    assert via_script["phases"]["histogram"]["max_skew"] == \
+        direct["phases"]["histogram"]["max_skew"]
+    assert via_script["barrier_wait_s"]["p0@vm"] == \
+        direct["barrier_wait_s"]["p0"]
+
+
+# ------------------------------------------------------ mesh collectives
+
+def test_exchange_times_and_survivor_vote_sites():
+    import jax
+    from jax.sharding import Mesh
+    from lightgbm_tpu.parallel.mesh import DATA_AXIS
+    mesh = Mesh(np.array(jax.devices()[:2]), (DATA_AXIS,))
+    telemetry.enable()
+    telemetry.reset()
+    try:
+        gathered = elastic.exchange_times(mesh, 0.25)
+        assert gathered.shape == (2,)
+        np.testing.assert_allclose(gathered, 0.25)
+        agreed = elastic.agree_survivors(mesh, np.array([1, 0, 1, 1]))
+        np.testing.assert_array_equal(agreed, [1, 0, 1, 1])
+        sites = telemetry.collectives()
+        assert "elastic/times_allgather" in sites
+        assert sites["elastic/times_allgather"]["kind"] == "all_gather"
+        assert "elastic/survivor_pmin" in sites
+        assert sites["elastic/survivor_pmin"]["kind"] == "pmin"
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+
+
+def test_host_times_from_gather_labels():
+    out = elastic.host_times_from_gather(
+        np.array([1.0, 1.0, 5.0, 5.0], np.float32), slots_per_host=2)
+    assert out == {"p0": 1.0, "p1": 5.0}
+
+
+# ---------------------------------------------- elastic restart / shrink
+
+@pytest.fixture()
+def data():
+    rng = np.random.RandomState(7)
+    x = rng.randn(1600, 10)
+    y = (x[:, 0] - x[:, 1] + 0.4 * rng.randn(1600) > 0).astype(np.float32)
+    return x, y
+
+
+def _make(x, y, num_machines, extra=None):
+    params = {"objective": "binary", "num_leaves": "8",
+              "min_data_in_leaf": "5", "min_sum_hessian_in_leaf": "0.1",
+              "learning_rate": "0.1", "verbose": "-1",
+              "grow_policy": "leafwise", "hist_dtype": "int8"}
+    if extra:
+        params.update(extra)
+    if num_machines > 1:
+        params.update({"tree_learner": "data",
+                       "num_machines": str(num_machines)})
+    cfg = OverallConfig()
+    cfg.set(params, require_data=False)
+    ds = Dataset.from_arrays(x, y, max_bin=63)
+    b = GBDT()
+    learner = create_parallel_learner(cfg) if num_machines > 1 else None
+    b.init(cfg.boosting_config, ds,
+           create_objective(cfg.objective_type, cfg.objective_config),
+           learner=learner)
+    return b, cfg
+
+
+def test_elastic_restore_different_topology_int8_bit_exact(data):
+    """Checkpoint on 4 machines, restore on 2: int8's ownership schedule
+    is topology-invariant, so the continuation is BIT-exact vs an
+    uninterrupted 2-machine run — the budget class asserted, not
+    hoped."""
+    x, y = data
+    a, _ = _make(x, y, 2)
+    a.run_training(8, is_eval=False)
+    ref = [t.to_string() for t in a.models]
+
+    b, _ = _make(x, y, 4)
+    b.run_training(4, is_eval=False)
+    payload = json.loads(json.dumps(
+        ckpt.serialize_state(b.checkpoint_state())))
+    c, _ = _make(x, y, 2)
+    c.restore_checkpoint(payload)
+    c.run_training(4, is_eval=False)
+    assert [t.to_string() for t in c.models] == ref
+    np.testing.assert_array_equal(np.asarray(c.score), np.asarray(a.score))
+
+
+def test_elastic_restore_different_topology_f32_budget(data):
+    """f32 across topologies: exact structure, leaf values within the
+    documented cross-schedule budget (the psum grouping differs)."""
+    x, y = data
+    a, _ = _make(x, y, 2, {"hist_dtype": "float32"})
+    a.run_training(8, is_eval=False)
+
+    b, _ = _make(x, y, 4, {"hist_dtype": "float32"})
+    b.run_training(4, is_eval=False)
+    payload = ckpt.serialize_state(b.checkpoint_state())
+    c, _ = _make(x, y, 2, {"hist_dtype": "float32"})
+    c.restore_checkpoint(payload)
+    c.run_training(4, is_eval=False)
+    assert len(c.models) == len(a.models) == 8
+    for t1, t2 in zip(a.models, c.models):
+        assert t1.num_leaves == t2.num_leaves
+        np.testing.assert_array_equal(t1.split_feature, t2.split_feature)
+        np.testing.assert_array_equal(t1.threshold_bin, t2.threshold_bin)
+        np.testing.assert_allclose(t1.leaf_value, t2.leaf_value,
+                                   rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(a.score), np.asarray(c.score),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_live_mesh_shrink_drain_at_boundary(data):
+    """The live policy: injected observations flag slot 3 as a
+    persistent straggler; the trainer checkpoints at the boundary,
+    re-factors 4 -> 3 machines mid-run, and the final model is bit-exact
+    (int8) vs training on 3 machines from the start."""
+    x, y = data
+    ref, _ = _make(x, y, 3)
+    ref.run_training(8, is_eval=False)
+    ref_trees = [t.to_string() for t in ref.models]
+
+    b, cfg = _make(x, y, 4)
+
+    def factory(num_machines, _cfg=cfg):
+        _cfg.network_config.num_machines = int(num_machines)
+        return create_parallel_learner(_cfg)
+
+    mon = b.enable_elastic(factory, exchange=False)
+    fed = {"n": 0}
+    orig_step = b._elastic_step
+
+    def feed_then_step():
+        # harness-injected observations (a real multi-process run feeds
+        # these from exchange_times): slot 3 strictly slowest until the
+        # shrink consumes the flag
+        if b._learner.config.network_config.num_machines == 4:
+            fed["n"] += 1
+            mon.observe(fed["n"], {"p0": 1.0, "p1": 1.0, "p2": 1.0,
+                                   "p3": 5.0})
+        return orig_step()
+
+    b._elastic_step = feed_then_step
+    b.run_training(8, is_eval=False)
+    assert b._learner.config.network_config.num_machines == 3
+    assert len(b.models) == 8
+    assert [t.to_string() for t in b.models] == ref_trees
+
+
+def test_shrink_at_min_mesh_warns_and_disarms(data):
+    x, y = data
+    b, cfg = _make(x, y, 2)
+
+    def factory(num_machines, _cfg=cfg):
+        _cfg.network_config.num_machines = int(num_machines)
+        return create_parallel_learner(_cfg)
+
+    mon = b.enable_elastic(factory, exchange=False)
+    # first shrink 2 -> 1 is refused? no: cur=2 > 1, shrinks to 1; the
+    # NEXT flag on the 1-machine mesh must warn-and-disarm, never loop
+    b._elastic_shrink("p1")
+    assert b._learner.config.network_config.num_machines == 1
+    b._straggler_monitor = mon
+    assert b._elastic_shrink("p0") is False
+    assert b._straggler_monitor is None
+
+
+# -------------------------------------------------------- fault injection
+
+def test_fault_parse_spec():
+    assert faults.parse_spec("7") == (7, "kill")
+    assert faults.parse_spec("3,stall") == (3, "stall")
+    with pytest.raises(log.LightGBMError, match="kind"):
+        faults.parse_spec("3,explode")
+    with pytest.raises(log.LightGBMError, match="int"):
+        faults.parse_spec("soon")
+
+
+def test_fault_stall_and_raise(data, monkeypatch):
+    x, y = data
+    monkeypatch.setenv(faults.ENV_STALL_S, "0.01")
+    faults.arm(2, "stall")
+    try:
+        b, _ = _make(x, y, 1)
+        b.run_training(4, is_eval=False)
+        assert len(b.models) == 4          # stall delays, never corrupts
+        assert faults._fired
+    finally:
+        faults.disarm()
+    faults.arm(2, "raise")
+    try:
+        c, _ = _make(x, y, 1)
+        with pytest.raises(RuntimeError, match="injected fault"):
+            c.run_training(4, is_eval=False)
+        # fired at the boundary after 2 consumed iterations; the
+        # crash-flush best-effort consumes a pipelined in-flight entry,
+        # so 2 (synchronous) or 3 (pipelined) trees survive — never 4
+        assert 2 <= len(c.models) <= 3
+    finally:
+        faults.disarm()
+    assert not faults.armed()
+
+
+def test_fault_kill_env_sigkills_training(tmp_path):
+    """The env hatch SIGKILLs a real training process between
+    iterations — and the checkpoints written before the kill survive."""
+    script = textwrap.dedent("""
+        import os
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import numpy as np
+        from lightgbm_tpu.config import OverallConfig
+        from lightgbm_tpu.io.dataset import Dataset
+        from lightgbm_tpu.models.gbdt import GBDT
+        from lightgbm_tpu.objectives import create_objective
+        rng = np.random.RandomState(0)
+        x = rng.randn(600, 6)
+        y = (x[:, 0] > 0).astype(np.float32)
+        cfg = OverallConfig()
+        cfg.set({"objective": "binary", "num_leaves": "4",
+                 "min_data_in_leaf": "4", "min_sum_hessian_in_leaf": "0.1",
+                 "learning_rate": "0.1", "verbose": "-1",
+                 "checkpoint_interval": "1",
+                 "checkpoint_dir": %r}, require_data=False)
+        ds = Dataset.from_arrays(x, y, max_bin=16)
+        b = GBDT()
+        b.init(cfg.boosting_config, ds,
+               create_objective(cfg.objective_type, cfg.objective_config))
+        b.run_training(8, is_eval=False)
+        print("NOT_KILLED")
+    """ % str(tmp_path / "ck"))
+    env = dict(os.environ)
+    env["LGBM_TPU_FAULT_AT"] = "3,kill"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    res = subprocess.run([sys.executable, "-c", script], env=env,
+                         capture_output=True, text=True, timeout=300)
+    assert res.returncode == -signal.SIGKILL, (res.returncode, res.stderr)
+    assert "NOT_KILLED" not in res.stdout
+    latest = ckpt.latest_checkpoint(str(tmp_path / "ck"))
+    assert latest is not None
+    payload = ckpt.load_checkpoint(latest)
+    assert payload["iteration"] >= 1

@@ -225,6 +225,69 @@ def test_refuses_cross_hardware_comparison(tmp_path):
     assert report["findings"]
 
 
+def test_ckpt_restore_exact_false_flagged_absolutely(tmp_path):
+    """ISSUE 14: a round recording a non-bit-identical same-topology
+    checkpoint restore fails the gate with NO trajectory — on any round,
+    not only the latest."""
+    paths = _history(tmp_path, [1.67, 1.67],
+                     extra={"ckpt_restore_exact": True})
+    paths.append(_write_round(tmp_path, 3, 1.67,
+                              extra={"ckpt_restore_exact": False}))
+    paths.append(_write_round(tmp_path, 4, 1.67,
+                              extra={"ckpt_restore_exact": True}))
+    report = perf_gate.check_files(paths)
+    assert any(f["key"] == "ckpt_restore_exact"
+               and f["latest_round"] == 3 for f in report["findings"])
+    # True everywhere (or absent on older rounds) passes
+    sub = tmp_path / "clean"
+    sub.mkdir()
+    clean = perf_gate.check_files(_history(
+        sub, [1.67, 1.67, 1.67], extra={"ckpt_restore_exact": True}))
+    assert not clean["findings"]
+
+
+def test_ckpt_overhead_growth_flagged(tmp_path):
+    """ckpt_overhead_pct rides the must-not-grow latency lane at the
+    wide observability floor: stable passes, an order-of-magnitude
+    growth is flagged."""
+    stable = _history(tmp_path, [1.67, 1.67, 1.67],
+                      extra={"ckpt_overhead_pct": 2.0})
+    assert not perf_gate.check_files(stable)["findings"]
+    grown = list(stable)
+    grown.append(_write_round(tmp_path, 4, 1.67,
+                              extra={"ckpt_overhead_pct": 40.0}))
+    report = perf_gate.check_files(grown)
+    assert any(f["key"] == "ckpt_overhead_pct" for f in report["findings"])
+
+
+def test_multichip_elastic_contracts_flagged(tmp_path):
+    """ISSUE 14: the kill-restart row's restore_match/metrics_complete
+    False are absolute findings, parsed from the MULTICHIP_ELASTIC tail
+    line like the OBS/WIRE blocks."""
+    good = tmp_path / "MULTICHIP_r01.json"
+    good.write_text(json.dumps({
+        "n_devices": 8, "rc": 0, "ok": True,
+        "tail": "MULTICHIP_ELASTIC " + json.dumps(
+            {"restore_match": True, "metrics_complete": True,
+             "trees": 8}) + "\n"}))
+    assert not perf_gate.check_files([str(good)])["findings"]
+    bad = tmp_path / "MULTICHIP_r02.json"
+    bad.write_text(json.dumps({
+        "n_devices": 8, "rc": 0, "ok": True,
+        "tail": "MULTICHIP_ELASTIC " + json.dumps(
+            {"restore_match": False, "metrics_complete": True}) + "\n"}))
+    report = perf_gate.check_files([str(good), str(bad)])
+    assert any(f["key"] == "elastic/restore_match"
+               for f in report["findings"])
+    lost = tmp_path / "MULTICHIP_r03.json"
+    lost.write_text(json.dumps({
+        "n_devices": 8, "rc": 0, "ok": True,
+        "elastic": {"restore_match": True, "metrics_complete": False}}))
+    report = perf_gate.check_files([str(good), str(lost)])
+    assert any(f["key"] == "elastic/metrics_complete"
+               for f in report["findings"])
+
+
 def test_multichip_ok_to_notok_flagged(tmp_path):
     ok = tmp_path / "MULTICHIP_r01.json"
     ok.write_text(json.dumps({"n_devices": 8, "rc": 0, "ok": True}))
