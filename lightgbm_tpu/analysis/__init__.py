@@ -12,6 +12,11 @@ package encodes those invariants ONCE as machine-checked rules:
 - **Layer 2 (jaxpr)** — ``jaxpr_rules`` over the canonical small-schema
   programs (``programs``): J1 dtype discipline on the int8 accumulator
   path, J2 collective census vs the declared telemetry seam inventory.
+- **Layer 3 (ISSUE 15, no JAX import)** — ``concurrency_rules``: C1
+  thread-lifecycle-registration, C2 future-set-race, C3
+  blocking-under-lock, C4 env-hatch-discipline over the threaded
+  subsystems; and ``drift_rules``: D1 telemetry-inventory, D2
+  perf-gate-coverage, D3 config-knob-inventory cross-artifact censuses.
 
 Drive it with ``python scripts/graftlint.py --check`` (exit 0 clean / 1
 findings / 2 tool error, mirroring perf_gate) or through the tier-1
@@ -22,5 +27,9 @@ always explicit, never silent.
 from .findings import RULES, Baseline, Finding               # noqa: F401
 from .ast_rules import (LintConfig, lint_package,            # noqa: F401
                         run_ast_rules)
-from .driver import (GraftlintError, default_baseline_path,  # noqa: F401
-                     package_root, run, run_ast_layer, run_jaxpr_layer)
+from .concurrency_rules import (ConcurrencyConfig,           # noqa: F401
+                                run_concurrency_rules)
+from .driver import (ALL_LAYERS, GraftlintError,             # noqa: F401
+                     default_baseline_path, package_root, run,
+                     run_ast_layer, run_concurrency_layer,
+                     run_drift_layer, run_jaxpr_layer)

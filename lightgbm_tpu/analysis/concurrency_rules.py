@@ -1,0 +1,364 @@
+"""Layer 3a: concurrency-lifecycle rules over the threaded subsystems.
+
+PRs 12-14 each burned a review-hardening pass on the same thread/Future
+lifecycle defect family — the ServingFront's cancelled-Future race, the
+CheckpointWriter join-timeout thread escaping the leak guard, the armed
+fault hatch leaking across tests, the io/parser prefetch thread with no
+guard registration at all.  These rules turn that family into a gated
+check, like graftlint's R-rules did for the seam/cache-key classes:
+
+- **C1 thread-lifecycle-registration** — every ``threading.Thread``
+  spawn site must be reachable by the shared live-object inventory
+  (``lightgbm_tpu/lifecycle.py``) the conftest leak guard consumes: a
+  spawn inside a class requires BOTH a close/stop/shutdown/join entry
+  point on the class and a ``lifecycle.track(...)`` call somewhere in
+  the class; a bare function spawn requires the ``track`` call in the
+  same function.  A thread class that forgets to register is invisible
+  to the guard until someone remembers to extend conftest — exactly the
+  hole the parser prefetch thread shipped through.
+- **C2 future-set-race** — ``Future.set_result``/``set_exception`` in
+  worker code must run inside a ``try`` whose handler absorbs the
+  cancelled/``InvalidStateError`` race: a client cancelling between a
+  ``cancelled()`` check and the set raises in the WORKER thread, killing
+  the serve loop and wedging every later request (the exact PR 13 bug,
+  generalized).  A bare ``if not fut.cancelled():`` guard is not enough
+  — the check→set window is the race.
+- **C3 blocking-under-lock** — no blocking operation lexically inside a
+  ``with <lock>:`` body (lock-ish context names: ``*lock*``/``*cv*``/
+  ``*cond*``/``*mutex*``): thread ``.join``, ``time.sleep``, ``open``,
+  un-timed queue ``get``/``put``, un-timed ``Event.wait``, un-timed
+  ``Future.result``, and device dispatch/sync (``device_put``/
+  ``block_until_ready``).  ``wait``/``notify`` on the lock object
+  itself are exempt (a condition wait RELEASES the lock).  A blocking
+  call under a held lock stalls every other thread contending it — the
+  ServingFront's submit path must stay wait-free while a batch is on
+  device.
+- **C4 env-hatch-discipline** — every ``os.environ``/``os.getenv`` read
+  of an ``LGBM_TPU_*`` name must go through the loud-reject helper
+  (``lightgbm_tpu/hatches.py``), and every helper call must name a
+  hatch present in the generated ``hatches.HATCHES`` inventory — a
+  typo'd hatch value silently doing nothing, and a hatch missing from
+  the inventory, are both the drift this rule retires.  Reads through a
+  module-level ``NAME = "LGBM_TPU_..."`` constant are resolved, so the
+  rule cannot be laundered through an alias.
+
+Pure ``ast`` plus one optional stdlib-only import (the hatches
+inventory) — no JAX, so the layer gates environments where the
+accelerator stack is absent, like layer 1.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set
+
+from .ast_rules import (_annotate_parents, _attr_chain, _enclosing,
+                        _func_qualname, _terminal_name)
+from .findings import Finding
+
+CLOSE_METHODS = frozenset({"close", "stop", "shutdown", "join", "disarm"})
+LOCKISH_RE = re.compile(r"(lock|cv|cond|mutex)", re.IGNORECASE)
+HATCH_PREFIX = "LGBM_TPU_"
+HATCH_HELPERS = frozenset({"flag", "choice", "raw", "int_value",
+                           "float_value"})
+# exception names whose handler absorbs the Future set race (C2)
+C2_HANDLERS = frozenset({"Exception", "BaseException", "InvalidStateError",
+                         "CancelledError"})
+
+
+def _default_hatch_inventory() -> Set[str]:
+    from .. import hatches
+    return set(hatches.HATCHES)
+
+
+class ConcurrencyConfig:
+    """Per-run knobs, overridable by tests (golden fixtures supply their
+    own hatch inventory so the rule checks the CLASS, not today's
+    inventory)."""
+
+    def __init__(self, hatch_inventory: Optional[Set[str]] = None,
+                 hatch_module_suffixes=("lightgbm_tpu/hatches.py",)):
+        self.hatch_inventory = (set(hatch_inventory)
+                                if hatch_inventory is not None
+                                else _default_hatch_inventory())
+        self.hatch_module_suffixes = tuple(hatch_module_suffixes)
+
+
+def _walk_skip_defs(node: ast.AST):
+    """``ast.walk`` that prunes nested function/lambda bodies — code
+    defined under a ``with`` runs LATER, not under the lock (C3)."""
+    stack = [node]
+    while stack:
+        cur = stack.pop()
+        yield cur
+        for child in ast.iter_child_nodes(cur):
+            if not isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                stack.append(child)
+
+
+def _is_lifecycle_track(node: ast.AST) -> bool:
+    """A registration call: ``lifecycle.track(...)`` (any alias whose
+    penultimate chain element names the lifecycle module)."""
+    if not isinstance(node, ast.Call):
+        return False
+    chain = _attr_chain(node.func)
+    return (bool(chain) and chain[-1] == "track"
+            and (len(chain) == 1 or "lifecycle" in chain[-2]))
+
+
+class ConcurrencyLint:
+    """One parsed module + the C-rule passes."""
+
+    def __init__(self, path: str, source: str, config: ConcurrencyConfig):
+        self.path = path
+        self.config = config
+        self.tree = ast.parse(source, filename=path)
+        self.parents = _annotate_parents(self.tree)
+        self.findings: List[Finding] = []
+        # module-level NAME = "LGBM_TPU_..." constants (C4 alias chase)
+        self.env_consts: Dict[str, str] = {}
+        for node in self.tree.body:
+            if (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, str)
+                    and node.value.value.startswith(HATCH_PREFIX)):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        self.env_consts[tgt.id] = node.value.value
+
+    # ------------------------------------------------------------ rule C1
+
+    def _enclosing_class(self, node: ast.AST) -> Optional[ast.ClassDef]:
+        for anc in _enclosing(node, self.parents):
+            if isinstance(anc, ast.ClassDef):
+                return anc
+            if isinstance(anc, ast.Module):
+                return None
+        return None
+
+    def _enclosing_function(self, node: ast.AST):
+        for anc in _enclosing(node, self.parents):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return anc
+        return None
+
+    def rule_c1(self) -> None:
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _attr_chain(node.func)
+            if not chain or chain[-1] != "Thread":
+                continue
+            if len(chain) >= 2 and chain[-2] != "threading":
+                continue
+            qual = _func_qualname(node, self.parents)
+            cls = self._enclosing_class(node)
+            if cls is not None:
+                has_close = any(
+                    isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and n.name in CLOSE_METHODS for n in cls.body)
+                registers = any(_is_lifecycle_track(n)
+                                for n in ast.walk(cls))
+                if not has_close:
+                    self.findings.append(Finding(
+                        "C1", self.path, node.lineno, qual,
+                        "threading.Thread",
+                        "thread spawned by class %s, which exposes no "
+                        "close/stop/shutdown/join entry point — nothing "
+                        "can ever reap it" % cls.name))
+                elif not registers:
+                    self.findings.append(Finding(
+                        "C1", self.path, node.lineno, qual,
+                        "threading.Thread",
+                        "thread-owning class %s never calls "
+                        "lifecycle.track(...) — the shared leak-guard "
+                        "inventory cannot see a leaked instance"
+                        % cls.name))
+                continue
+            fn = self._enclosing_function(node)
+            if fn is None or not any(_is_lifecycle_track(n)
+                                     for n in ast.walk(fn)):
+                self.findings.append(Finding(
+                    "C1", self.path, node.lineno, qual,
+                    "threading.Thread",
+                    "bare thread spawn without a lifecycle.track(...) "
+                    "registration in the same function — invisible to "
+                    "the leak guard"))
+
+    # ------------------------------------------------------------ rule C2
+
+    def _in_guarding_try(self, node: ast.AST) -> bool:
+        for anc in _enclosing(node, self.parents):
+            if not isinstance(anc, ast.Try):
+                continue
+            # the call must sit in the try BODY (a set inside a
+            # handler/finally is not protected by these handlers)
+            in_body = any(node is sub for stmt in anc.body
+                          for sub in ast.walk(stmt))
+            if not in_body:
+                continue
+            for handler in anc.handlers:
+                if handler.type is None:
+                    return True
+                types = (handler.type.elts
+                         if isinstance(handler.type, ast.Tuple)
+                         else [handler.type])
+                if any(_terminal_name(t) in C2_HANDLERS for t in types):
+                    return True
+        return False
+
+    def rule_c2(self) -> None:
+        for node in ast.walk(self.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("set_result", "set_exception")):
+                continue
+            if not self._in_guarding_try(node):
+                self.findings.append(Finding(
+                    "C2", self.path, node.lineno,
+                    _func_qualname(node, self.parents),
+                    "." + node.func.attr,
+                    "Future %s outside a try/except absorbing the "
+                    "cancelled/InvalidStateError race — a client cancel "
+                    "in the check→set window raises in the worker loop "
+                    "and wedges it" % node.func.attr))
+
+    # ------------------------------------------------------------ rule C3
+
+    @staticmethod
+    def _lockish(expr: ast.AST) -> Optional[str]:
+        chain = _attr_chain(expr)
+        if chain and LOCKISH_RE.search(chain[-1]):
+            return ".".join(chain)
+        return None
+
+    def _blocking_site(self, node: ast.AST, lock_chain: str
+                       ) -> Optional[str]:
+        if not isinstance(node, ast.Call):
+            return None
+        chain = _attr_chain(node.func)
+        if not chain:
+            return None
+        name = chain[-1]
+        recv = ".".join(chain[:-1])
+        has_timeout = (any(kw.arg == "timeout" for kw in node.keywords)
+                       or len(node.args) >= (2 if name == "put" else 1))
+        if recv == lock_chain:
+            return None           # cv.wait()/notify release/own the lock
+        if name == "join" and not isinstance(
+                getattr(node.func, "value", None), ast.Constant):
+            return ".".join(chain)
+        if name == "sleep" and chain[0] == "time":
+            return ".".join(chain)
+        if name == "open" and len(chain) == 1:
+            return "open"
+        if name in ("block_until_ready", "device_put"):
+            return ".".join(chain)
+        if name == "get" and not node.args and not node.keywords:
+            return ".".join(chain) + "()"
+        if name == "put" and node.args and not has_timeout:
+            return ".".join(chain)
+        if name in ("wait", "result") and not node.args \
+                and not node.keywords:
+            return ".".join(chain) + "()"
+        return None
+
+    def rule_c3(self) -> None:
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.With):
+                continue
+            for item in node.items:
+                lock_chain = self._lockish(item.context_expr)
+                if lock_chain is None:
+                    continue
+                for stmt in node.body:
+                    for sub in _walk_skip_defs(stmt):
+                        site = self._blocking_site(sub, lock_chain)
+                        if site is not None:
+                            self.findings.append(Finding(
+                                "C3", self.path, sub.lineno,
+                                _func_qualname(sub, self.parents), site,
+                                "blocking operation lexically inside "
+                                "`with %s:` — every thread contending "
+                                "the lock stalls behind it"
+                                % lock_chain))
+
+    # ------------------------------------------------------------ rule C4
+
+    def _hatch_name(self, arg: ast.AST) -> Optional[str]:
+        """The LGBM_TPU_* name an argument resolves to (constant, or a
+        module-level constant alias), else None."""
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            return arg.value if arg.value.startswith(HATCH_PREFIX) else None
+        if isinstance(arg, ast.Name):
+            return self.env_consts.get(arg.id)
+        chain = _attr_chain(arg)
+        if len(chain) == 2 and chain[-1] in self.env_consts:
+            # cross-module alias (faults.ENV_VAR): resolvable only when
+            # the constant lives in THIS module; foreign aliases are out
+            # of lexical reach and stay the owning module's finding
+            return self.env_consts[chain[-1]]
+        return None
+
+    def rule_c4(self) -> None:
+        if any(self.path.endswith(sfx)
+               for sfx in self.config.hatch_module_suffixes):
+            return                      # the helper itself reads os.environ
+        for node in ast.walk(self.tree):
+            name = None
+            site = None
+            if isinstance(node, ast.Call):
+                chain = _attr_chain(node.func)
+                if (chain[-2:] == ["environ", "get"]
+                        or chain == ["os", "getenv"]) and node.args:
+                    name = self._hatch_name(node.args[0])
+                    site = ".".join(chain)
+                elif (chain and chain[-1] in HATCH_HELPERS
+                        and len(chain) >= 2 and "hatches" in chain[-2]
+                        and node.args):
+                    hname = self._hatch_name(node.args[0])
+                    if (hname is not None
+                            and hname not in self.config.hatch_inventory):
+                        self.findings.append(Finding(
+                            "C4", self.path, node.lineno,
+                            _func_qualname(node, self.parents), hname,
+                            "hatch read through the helper but missing "
+                            "from the hatches.HATCHES inventory — the "
+                            "generated hatch inventory has drifted"))
+                    continue
+            elif (isinstance(node, ast.Subscript)
+                    and _attr_chain(node.value)[-2:] == ["os", "environ"]):
+                par = self.parents.get(node)
+                if isinstance(par, (ast.Assign, ast.AugAssign)) \
+                        and getattr(par, "targets", [None])[0] is node:
+                    continue            # writes (harness arming) are fine
+                name = self._hatch_name(node.slice)
+                site = "os.environ[...]"
+            if name is not None:
+                self.findings.append(Finding(
+                    "C4", self.path, node.lineno,
+                    _func_qualname(node, self.parents), name,
+                    "raw %s read of %s bypasses the loud-reject hatch "
+                    "helper — a typo'd value silently does nothing "
+                    "instead of rejecting" % (site, name)))
+
+    def run(self) -> List[Finding]:
+        self.rule_c1()
+        self.rule_c2()
+        self.rule_c3()
+        self.rule_c4()
+        return self.findings
+
+
+def run_concurrency_rules(files: Dict[str, str],
+                          config: Optional[ConcurrencyConfig] = None
+                          ) -> List[Finding]:
+    """Run every C-rule over ``{path: source}``; findings sorted by
+    (path, line) like the R-rules."""
+    config = config or ConcurrencyConfig()
+    findings: List[Finding] = []
+    for path in sorted(files):
+        findings.extend(ConcurrencyLint(path, files[path], config).run())
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings

@@ -1,4 +1,10 @@
-"""graftlint driver: run both layers, apply the baseline, shape the exit.
+"""graftlint driver: run the analysis layers, apply the baseline, shape
+the exit.
+
+Four layers (ISSUE 10 + ISSUE 15): "ast" (R-rules), "jaxpr" (J-rules
+over the canonical traced programs), "concurrency" (C-rules over the
+threaded subsystems) and "drift" (D-rule cross-artifact censuses:
+telemetry families, perf_gate key coverage, the CLI knob inventory).
 
 Shared by ``scripts/graftlint.py`` (the pre-merge CLI beside
 ``perf_gate.py --check``) and the tier-1 pytest wrapper
@@ -9,11 +15,16 @@ perf_gate: 0 clean / 1 findings / 2 tool error.
 from __future__ import annotations
 
 import functools
+import glob
+import importlib.util
 import os
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from .ast_rules import LintConfig, lint_package
+from .concurrency_rules import ConcurrencyConfig, run_concurrency_rules
 from .findings import Baseline, Finding, split_baseline
+
+ALL_LAYERS = ("ast", "jaxpr", "concurrency", "drift")
 
 
 class GraftlintError(Exception):
@@ -33,13 +44,114 @@ def default_baseline_path() -> str:
     return os.path.join(repo_root(), "GRAFTLINT_BASELINE.json")
 
 
+def _package_sources(root: str) -> Dict[str, str]:
+    files: Dict[str, str] = {}
+    for dirpath, _dirnames, filenames in os.walk(root):
+        if "__pycache__" in dirpath:
+            continue
+        for fname in filenames:
+            if fname.endswith(".py"):
+                full = os.path.join(dirpath, fname)
+                with open(full) as f:
+                    files[full] = f.read()
+    return files
+
+
 def run_ast_layer(root: Optional[str] = None,
-                  config: Optional[LintConfig] = None) -> List[Finding]:
+                  config: Optional[LintConfig] = None,
+                  files: Optional[Dict[str, str]] = None) -> List[Finding]:
     try:
-        return lint_package(root or package_root(), config)
+        if files is None:
+            return lint_package(root or package_root(), config)
+        from .ast_rules import run_ast_rules
+        return run_ast_rules(files, config)
     except SyntaxError as e:
         raise GraftlintError("AST layer cannot parse %s: %s"
                              % (getattr(e, "filename", "?"), e))
+
+
+def run_concurrency_layer(root: Optional[str] = None,
+                          config: Optional[ConcurrencyConfig] = None,
+                          files: Optional[Dict[str, str]] = None
+                          ) -> List[Finding]:
+    """Layer 3a: C-rules over the package source (no JAX import)."""
+    try:
+        return run_concurrency_rules(
+            files if files is not None
+            else _package_sources(root or package_root()), config)
+    except SyntaxError as e:
+        raise GraftlintError("concurrency layer cannot parse %s: %s"
+                             % (getattr(e, "filename", "?"), e))
+
+
+def _load_perf_gate(repo: str):
+    path = os.path.join(repo, "scripts", "perf_gate.py")
+    spec = importlib.util.spec_from_file_location("_graftlint_perf_gate",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def run_drift_layer(root: Optional[str] = None,
+                    files: Optional[Dict[str, str]] = None
+                    ) -> List[Finding]:
+    """Layer 3b: cross-artifact censuses (D1 telemetry families, D2
+    perf_gate coverage, D3 config knob inventory).  Reads the repo's
+    real artifacts; stdlib only (no JAX)."""
+    from .drift_rules import (check_knob_inventory,
+                              check_perf_gate_coverage,
+                              check_telemetry_inventory,
+                              recorded_round_keys)
+    pkg = root or package_root()
+    repo = os.path.dirname(pkg)
+    if files is None:
+        files = _package_sources(pkg)
+    findings: List[Finding] = []
+    try:
+        tel_path = next(p for p in files if p.endswith("telemetry.py"))
+        findings.extend(check_telemetry_inventory(
+            files, telemetry_path=tel_path))
+        gate_mod = _load_perf_gate(repo)
+        with open(os.path.join(repo, "scripts", "perf_gate.py")) as f:
+            gate_src = f.read()
+        gate_sets = {
+            "RATE_KEYS": gate_mod.RATE_KEYS,
+            "LATENCY_KEYS": gate_mod.LATENCY_KEYS,
+            "ABSOLUTE_ZERO_KEYS": gate_mod.ABSOLUTE_ZERO_KEYS,
+            "ABSOLUTE_TRUE_KEYS": gate_mod.ABSOLUTE_TRUE_KEYS,
+            "_source": gate_src,
+        }
+        with open(os.path.join(repo, "bench.py")) as f:
+            bench_src = f.read()
+        entry_path = os.path.join(repo, "__graft_entry__.py")
+        entry_src = ""
+        if os.path.exists(entry_path):
+            with open(entry_path) as f:
+                entry_src = f.read()
+        rounds = {}
+        for pat in ("BENCH_r*.json", "MULTICHIP_r*.json"):
+            for p in glob.glob(os.path.join(repo, pat)):
+                with open(p) as f:
+                    rounds[p] = f.read()
+        findings.extend(check_perf_gate_coverage(
+            gate_sets, bench_src, entry_src,
+            recorded_keys=recorded_round_keys(rounds),
+            gate_path=os.path.join(repo, "scripts", "perf_gate.py"),
+            bench_path=os.path.join(repo, "bench.py")))
+        cfg_path = next(p for p in files if p.endswith("config.py")
+                        and "analysis" not in p)
+        cli_path = next(p for p in files if p.endswith("cli.py"))
+        findings.extend(check_knob_inventory(
+            files[cfg_path], files[cli_path],
+            config_path=cfg_path, cli_path=cli_path))
+    except GraftlintError:
+        raise
+    except (OSError, StopIteration, SyntaxError, AttributeError) as e:
+        raise GraftlintError("drift layer failed: %s: %s"
+                             % (type(e).__name__, e))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
 
 
 @functools.lru_cache(maxsize=4)
@@ -70,17 +182,27 @@ def run_jaxpr_layer(parallel: bool = True) -> List[Finding]:
                              % (type(e).__name__, e))
 
 
-def run(layers=("ast", "jaxpr"), baseline: Optional[Baseline] = None,
+def run(layers=ALL_LAYERS, baseline: Optional[Baseline] = None,
         root: Optional[str] = None,
         config: Optional[LintConfig] = None) -> dict:
     """Run the requested layers and split by the baseline.  Returns
     ``{"findings", "suppressed", "stale_baseline"}``; raises
     GraftlintError on tool failure."""
     findings: List[Finding] = []
+    # one disk pass shared by every source-reading layer: all of them
+    # lint the identical snapshot, and a default --check stops slurping
+    # the package three times over
+    files: Optional[Dict[str, str]] = None
+    if {"ast", "concurrency", "drift"} & set(layers):
+        files = _package_sources(root or package_root())
     if "ast" in layers:
-        findings.extend(run_ast_layer(root, config))
+        findings.extend(run_ast_layer(root, config, files=files))
     if "jaxpr" in layers:
         findings.extend(run_jaxpr_layer())
+    if "concurrency" in layers:
+        findings.extend(run_concurrency_layer(root, files=files))
+    if "drift" in layers:
+        findings.extend(run_drift_layer(root, files=files))
     kept, suppressed = split_baseline(findings, baseline)
     return {
         "findings": kept,

@@ -47,6 +47,39 @@ RULES = {
            "the collective eqns XLA will execute must match the declared "
            "telemetry seam inventory — wrap the new collective, or remove "
            "the stale record_collective site"),
+    "C1": ("thread-lifecycle-registration",
+           "give the thread-owning class a close/stop entry point and "
+           "register it with lifecycle.track(...) (bare function spawns "
+           "track in the same function), so the shared conftest leak "
+           "guard can see a leaked instance"),
+    "C2": ("future-set-race",
+           "wrap the set_result/set_exception in try/except Exception — "
+           "a client cancel in the check→set window raises "
+           "InvalidStateError in the worker loop and wedges it (the "
+           "PR 13 ServingFront bug class)"),
+    "C3": ("blocking-under-lock",
+           "move the blocking call (join/sleep/IO/device dispatch/"
+           "un-timed queue op) outside the `with <lock>:` body — only "
+           "waits on the lock object itself release it"),
+    "C4": ("env-hatch-discipline",
+           "read the LGBM_TPU_* variable through lightgbm_tpu/hatches "
+           "(flag/choice/raw/int_value/float_value) and register it in "
+           "hatches.HATCHES — raw os.environ reads silently ignore "
+           "typo'd values and escape the generated hatch inventory"),
+    "D1": ("telemetry-inventory-census",
+           "add the emitted counter/span/wire name to the matching "
+           "*_FAMILIES tuple in telemetry.py — or delete the stale "
+           "inventory line the code no longer emits (the inventory IS "
+           "the family documentation)"),
+    "D2": ("perf-gate-coverage-census",
+           "wire the emitted key into the matching perf_gate key set "
+           "(or justify it on drift_rules.D2_INFORMATIONAL); delete or "
+           "re-source gate keys nothing emits — a stale gate key "
+           "silently gates nothing"),
+    "D3": ("config-knob-census",
+           "add the knob to cli.KNOB_INVENTORY and give its parse a "
+           "reject path (typed loud getter or log.check/log.fatal), or "
+           "justify it on drift_rules.D3_FREEFORM/D3_INTERNAL"),
 }
 
 

@@ -54,7 +54,7 @@ from typing import List, Optional
 
 import numpy as np
 
-from . import telemetry
+from . import lifecycle, telemetry
 from .utils import log
 
 MAGIC = "lightgbm_tpu_checkpoint"
@@ -63,9 +63,10 @@ _HEADER_RE = re.compile(
     r"^lightgbm_tpu_checkpoint v(\d+) sha256=([0-9a-f]{64}) bytes=(\d+)\n")
 _CKPT_NAME_RE = re.compile(r"^ckpt-(\d{8})\.json$")
 
-# live async writers, for the conftest leak guard (a test leaving a writer
-# thread alive would keep writing into a shared tmpdir after teardown)
-_LIVE_WRITERS: "set[CheckpointWriter]" = set()
+# the shared lifecycle inventory's kind tag for async writers: the
+# conftest leak guard (and graftlint C1) consume lifecycle.py's single
+# registry instead of a per-module set (ISSUE 15)
+WRITER_KIND = "ckpt-writer"
 
 
 class CheckpointError(Exception):
@@ -75,8 +76,9 @@ class CheckpointError(Exception):
 
 
 def live_writers() -> int:
-    """Number of CheckpointWriter threads still running (leak guard)."""
-    return len(_LIVE_WRITERS)
+    """Number of CheckpointWriter threads still registered live (the
+    lifecycle inventory view; kept as the module's historical API)."""
+    return lifecycle.live_count(WRITER_KIND)
 
 
 # ---------------------------------------------------------- serialization
@@ -382,7 +384,7 @@ class CheckpointWriter:
         self.dropped = 0
         self._thread = threading.Thread(
             target=self._run, name="lgbm-tpu-ckpt-writer", daemon=True)
-        _LIVE_WRITERS.add(self)
+        lifecycle.track(WRITER_KIND, self, self.close)
         self._thread.start()
 
     def submit(self, raw_state: dict) -> None:
@@ -437,7 +439,7 @@ class CheckpointWriter:
                         "%.1fs (hung write?); leaving it registered for "
                         "the leak guard" % join_s)
         else:
-            _LIVE_WRITERS.discard(self)
+            lifecycle.untrack(self)
         if self._error is not None:
             log.warning("checkpoint writer had failed earlier: %s"
                         % self._error)

@@ -114,6 +114,125 @@ import sys
 import time
 from typing import List
 
+# --------------------------------------------------------------------------
+# THE machine-readable knob inventory (ISSUE 15): one entry per canonical
+# ``key=value`` parameter any *Config.set reads (aliases resolve through
+# config.ALIAS_TABLE first).  graftlint D3 (analysis/drift_rules.py)
+# cross-checks this dict against config.py both ways — a knob parsed but
+# undocumented here, or an entry here nothing parses, fails the pre-merge
+# gate — so the CLI surface can no longer drift by convention.  Keep the
+# values one line: they are the --help-style summary; full semantics live
+# on the config.py field comments.
+
+KNOB_INVENTORY = {
+    # task / component selection
+    "task": "train or predict",
+    "boosting_type": "gbdt (gbrt alias)",
+    "objective": "objective name (regression/binary/multiclass/lambdarank)",
+    "metric": "comma list of eval metric names",
+    "device_type": "device selector resolved against jax.devices()",
+    "num_threads": "native OpenMP host-path thread count",
+    "predict_leaf_index": "predict per-tree leaf indices instead of scores",
+    # IO / data
+    "data": "training (or predict-input) data file",
+    "valid_data": "comma list of validation data files",
+    "max_bin": "max bins per feature",
+    "data_random_seed": "binning-sample / shard-draw seed",
+    "verbose": "log verbosity (-1 fatal .. 2 debug)",
+    "has_header": "first data line is a header",
+    "label_column": "label column selector",
+    "weight_column": "weight column selector",
+    "group_column": "query/group column selector",
+    "ignore_column": "columns to drop",
+    "is_pre_partition": "data files are pre-partitioned per machine",
+    "is_enable_sparse": "reference sparse-format toggle (kept for parity)",
+    "use_two_round_loading": "reference two-round loader (superseded by "
+                             "streaming)",
+    "is_save_binary_file": "write a binary dataset cache beside the data",
+    "save_binary_format": "native or reference cache layout",
+    "streaming": "auto/true/false chunked parse→bin→HBM loader",
+    "ingest_chunk_rows": "streaming chunk length (host-resident row bound)",
+    "output_model": "trained model output path",
+    "input_model": "model to continue training from / predict with",
+    "input_init_score": "initial-score side file",
+    "output_result": "prediction output path",
+    "num_model_predict": "how many trees predict uses (-1 = all)",
+    "is_sigmoid": "apply sigmoid to binary predict output",
+    # observability
+    "profile_dir": "jax.profiler trace output directory",
+    "metrics_out": "per-iteration JSONL telemetry sink path",
+    "metrics_fence": "block_until_ready-fence phase spans",
+    "memory_stats": "auto/true/false device-memory gauges",
+    "timeline": "auto/true/false per-process JSONL shards",
+    "stall_timeout": "hung-collective flight-recorder timeout (seconds)",
+    # serving
+    "predict_buckets": "compiled batch-shape ladder (comma ints)",
+    "predict_quantize": "float32 or int8 leaf-value serving tables",
+    "predict_donate": "auto/true/false codes-buffer donation",
+    "predict_algo": "bfs lockstep walk or scan per-tree replay (A/B)",
+    "serve_shards": "tree-axis ensemble shards (0 = single device)",
+    "predict_linger_us": "coalescing front max linger (microseconds)",
+    "predict_queue": "in-flight bound, in top-bucket batches",
+    # tree growth
+    "min_data_in_leaf": "min rows per leaf",
+    "min_sum_hessian_in_leaf": "min hessian mass per leaf",
+    "num_leaves": "max leaves per tree",
+    "max_depth": "max tree depth (<0 = unlimited)",
+    "feature_fraction": "per-tree feature subsample fraction",
+    "feature_fraction_seed": "feature-fraction RNG seed",
+    "histogram_pool_size": "reference LRU histogram pool (disabled "
+                           "distributed)",
+    "grow_policy": "leafwise best-first or depthwise level-batched",
+    "hist_chunk": "XLA histogram scan row-chunk (0 = per-policy default)",
+    "hist_dtype": "float32/bfloat16/int8 histogram operand dtype",
+    "dp_schedule": "auto/psum/reduce_scatter DP reduction schedule",
+    "leafwise_segments": "split the leafwise grow loop across N dispatches",
+    "leafwise_compact": "auto/true/false contiguous-leaf growth",
+    "mixed_bin": "auto/true/false per-bin-width-class histogram passes",
+    "feature_shards": "2-D mesh feature-axis factor (0 = auto)",
+    "top_k": "voting-parallel per-shard vote width",
+    "quant_rounding": "nearest or stochastic int8 gradient rounding",
+    # boosting loop
+    "num_iterations": "boosting iteration budget",
+    "learning_rate": "shrinkage rate",
+    "bagging_fraction": "row subsample fraction",
+    "bagging_freq": "iterations between bagging redraws (0 = off)",
+    "bagging_seed": "bagging RNG seed",
+    "bagging_device": "auto/true/false on-device bagging draws",
+    "goss": "gradient-based one-side sampling",
+    "top_rate": "GOSS top-gradient keep fraction",
+    "other_rate": "GOSS remainder sample fraction",
+    "early_stopping_round": "rounds without improvement before stop",
+    "metric_freq": "iterations between metric output lines",
+    "is_training_metric": "also evaluate metrics on the training set",
+    "num_class": "number of classes (multiclass)",
+    "sigmoid": "sigmoid steepness (binary objective/metric)",
+    "is_unbalance": "unbalanced-label weighting (binary)",
+    "label_gain": "per-label gain table (lambdarank)",
+    "max_position": "NDCG truncation position (lambdarank)",
+    "ndcg_eval_at": "NDCG eval positions",
+    # health monitor
+    "health": "auto/true/false training-health monitor",
+    "on_anomaly": "warn/halt/record anomaly policy",
+    "health_divergence_rounds": "consecutive worsening rounds that flag "
+                                "divergence (0 = off)",
+    # pipelining / checkpoints / elasticity
+    "pipeline": "auto/off/readback deferred-readback boosting",
+    "checkpoint_interval": "iterations between async checkpoints (0 = off)",
+    "checkpoint_dir": "checkpoint directory (required when interval > 0)",
+    "checkpoint_keep": "retained checkpoint files (>= 1)",
+    "elastic_shrink": "live straggler mesh-shrink policy",
+    "straggler_k": "consecutive strictly-slowest iterations that flag a "
+                   "straggler",
+    # distributed
+    "tree_learner": "serial/feature/data/hybrid/voting",
+    "num_machines": "machine (mesh-slot) count",
+    "local_listen_port": "reference networking option (parity)",
+    "time_out": "reference networking timeout (parity)",
+    "machine_list_file": "reference machine list (parity; TPU bootstrap "
+                         "uses env hatches)",
+}
+
 from . import config as config_mod
 from . import telemetry
 from .config import OverallConfig

@@ -393,7 +393,18 @@ class ObjectiveConfig:
         self.num_class = _get_int(params, "num_class", self.num_class)
         log.check(self.num_class >= 1, "num_class should be >= 1")
         if "label_gain" in params:
-            self.label_gain = [float(x) for x in params["label_gain"].split(",") if x]
+            self.label_gain = _parse_label_gain(params["label_gain"])
+
+
+def _parse_label_gain(value: str) -> List[float]:
+    """Loud-reject parse of the comma-separated label_gain list — a junk
+    token used to surface as a bare ValueError traceback instead of the
+    typed-getter fatal every other knob gets."""
+    try:
+        return [float(x) for x in value.split(",") if x]
+    except ValueError:
+        log.fatal("Parameter label_gain should be comma-separated "
+                  "doubles, passed is [%s]" % value)
 
 
 @dataclasses.dataclass
@@ -409,7 +420,7 @@ class MetricConfig:
         self.num_class = _get_int(params, "num_class", self.num_class)
         log.check(self.num_class >= 1, "num_class should be >= 1")
         if "label_gain" in params:
-            self.label_gain = [float(x) for x in params["label_gain"].split(",") if x]
+            self.label_gain = _parse_label_gain(params["label_gain"])
         if "ndcg_eval_at" in params:
             self.eval_at = sorted(int(x) for x in params["ndcg_eval_at"].split(",") if x)
             for k in self.eval_at:

@@ -31,6 +31,7 @@ import signal
 import time
 from typing import Optional, Tuple
 
+from . import hatches, lifecycle
 from .utils import log
 
 ENV_VAR = "LGBM_TPU_FAULT_AT"
@@ -81,20 +82,34 @@ def disarm() -> None:
     _fired = False
 
 
+def clear() -> None:
+    """Disarm AND drop the env-var arming — the leak-guard closer (a
+    foreign fault left armed either way would kill a later test's
+    training loop at its configured iteration)."""
+    disarm()
+    os.environ.pop(ENV_VAR, None)
+
+
 def armed() -> bool:
-    """True when a fault hatch is live — programmatic OR env (the conftest
-    leak guard consults this after every test)."""
-    return _armed is not None or bool(os.environ.get(ENV_VAR))
+    """True when a fault hatch is live — programmatic OR env (the shared
+    leak-guard inventory probes this after every test)."""
+    return _armed is not None or bool(hatches.raw(ENV_VAR))
+
+
+# the armed hatch is process-global state like a live thread: register it
+# with the shared lifecycle inventory so the conftest leak guard (and
+# graftlint C1's census of guard-visible subsystems) reads ONE registry
+lifecycle.probe("fault-hatch", armed, clear)
 
 
 def _spec() -> Optional[Tuple[int, str, int]]:
     if _armed is not None:
         return _armed
-    env = os.environ.get(ENV_VAR)
+    env = hatches.raw(ENV_VAR)
     if not env:
         return None
     iteration, kind = parse_spec(env)
-    proc = int(os.environ.get(ENV_PROC, "0"))
+    proc = hatches.int_value(ENV_PROC, 0)
     return iteration, kind, proc
 
 
@@ -131,7 +146,7 @@ def maybe_fire(iteration: int) -> None:
             pass
         os.kill(os.getpid(), signal.SIGKILL)
     elif kind == "stall":
-        stall = float(os.environ.get(ENV_STALL_S, "1.0"))
+        stall = hatches.float_value(ENV_STALL_S, 1.0)
         log.warning("fault injection: stalling %.3fs at iteration %d"
                     % (stall, iteration))
         time.sleep(stall)

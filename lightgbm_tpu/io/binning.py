@@ -14,11 +14,12 @@ lightgbm_tpu/io/dataset.py.
 """
 from __future__ import annotations
 
-import os
 from dataclasses import dataclass, field
 from typing import List, NamedTuple, Optional
 
 import numpy as np
+
+from .. import hatches
 
 
 @dataclass
@@ -321,7 +322,7 @@ def plan_feature_packing_blocked(num_bins, num_bins_max: int,
     worth serving), or a narrowest block with no narrow feature (the
     uniform per-block class counts would be ``(0, block)`` — one
     class)."""
-    if mode == "false" or os.environ.get("LGBM_TPU_NO_MIXEDBIN", "") == "1":
+    if mode == "false" or hatches.flag("LGBM_TPU_NO_MIXEDBIN"):
         return None
     nb = np.asarray(num_bins)
     F = nb.size
@@ -365,7 +366,7 @@ def plan_feature_packing(num_bins, num_bins_max: int,
     "auto"/"true" enable (auto and true only differ for callers that log
     the decision), "false" disables.  The ``LGBM_TPU_NO_MIXEDBIN=1`` env
     hatch forces off for A/B timing without touching configs."""
-    if mode == "false" or os.environ.get("LGBM_TPU_NO_MIXEDBIN", "") == "1":
+    if mode == "false" or hatches.flag("LGBM_TPU_NO_MIXEDBIN"):
         return None
     nb = np.asarray(num_bins)
     if nb.size == 0 or num_bins_max <= narrow_bins:

@@ -358,6 +358,8 @@ def prefetch_chunks(iterable, depth: int = 2):
     import queue
     import threading
 
+    from .. import lifecycle
+
     q: "queue.Queue" = queue.Queue(maxsize=depth)
     sentinel = object()
     err: List[BaseException] = []
@@ -385,8 +387,26 @@ def prefetch_chunks(iterable, depth: int = 2):
             # on a momentarily-full queue would strand the consumer in
             # q.get() forever (and swallow any stored producer exception)
             put_blocking(sentinel)
+            # self-deregistration: if _close's bounded join timed out (a
+            # slow chunk parse outliving the 1s grace), the entry must
+            # still clear when the thread actually exits — only a thread
+            # that never reaches here stays registered for the guard
+            lifecycle.untrack(thread)
 
-    threading.Thread(target=worker, daemon=True).start()
+    thread = threading.Thread(target=worker, name="lgbm-tpu-prefetch",
+                              daemon=True)
+
+    def _close() -> None:
+        """Stop-and-join closer: shared with the generator's own finally
+        and the lifecycle leak guard (a leaked prefetch thread holds the
+        underlying file handle open past the test that spawned it)."""
+        stop.set()
+        thread.join(1.0)
+        if not thread.is_alive():
+            lifecycle.untrack(thread)
+
+    lifecycle.track("prefetch", thread, _close)
+    thread.start()
     try:
         while True:
             item = q.get()
@@ -396,9 +416,10 @@ def prefetch_chunks(iterable, depth: int = 2):
                 return
             yield item
     finally:
-        # consumer stopped early (exception / generator close): unblock
-        # the worker so it exits and releases the underlying file handle
-        stop.set()
+        # consumer stopped early OR drained fully: unblock the worker so
+        # it exits (releasing the file handle) and deregister it from
+        # the live inventory once it is provably gone
+        _close()
 
 
 def read_lines(filename: str, skip_header: bool = False) -> List[str]:

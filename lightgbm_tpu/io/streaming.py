@@ -75,7 +75,7 @@ from typing import List, Optional
 
 import numpy as np
 
-from .. import telemetry
+from .. import hatches, telemetry
 from ..utils import log
 from . import parser as parser_mod
 
@@ -104,7 +104,7 @@ def resolve_streaming(io_config, path: str) -> bool:
 
 
 def double_buffer_on() -> bool:
-    return os.environ.get(SYNC_ENV, "") != "1"
+    return not hatches.flag(SYNC_ENV)
 
 
 def single_process() -> bool:

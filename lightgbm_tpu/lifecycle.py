@@ -1,0 +1,104 @@
+"""Shared live-object inventory for thread-owning subsystems (ISSUE 15).
+
+PRs 12-14 each grew a concurrent subsystem with its own private liveness
+bookkeeping — ``checkpoint._LIVE_WRITERS``, ``faults.armed()``, a
+watchdog flag in telemetry — and the test-suite leak guard
+(tests/conftest.py) had to hand-enumerate every one.  A new thread class
+was therefore INVISIBLE to the guard until someone remembered to extend
+conftest (the ``io/parser.py`` prefetch thread and the ServingFront
+worker both shipped without any registration path at all).  This module
+is the single registry both consumers read:
+
+- the conftest leak guard iterates :func:`leaks` after every test and
+  fails the offender, naming the leaked kind, then calls each entry's
+  ``closer`` so the rest of the suite runs unpoisoned;
+- graftlint C1 (analysis/concurrency_rules.py) requires every
+  ``threading.Thread`` spawn site to sit beside a :func:`track` call, so
+  a thread class that forgets to register fails the pre-merge gate
+  instead of silently escaping the guard.
+
+Two registration shapes:
+
+- :func:`track`/:func:`untrack` — a live OBJECT owning a thread (a
+  CheckpointWriter, a ServingFront, a prefetch handle).  ``closer`` must
+  be idempotent: the guard calls it on a leaked entry, and well-behaved
+  owners also call their own close twice (context manager + explicit).
+- :func:`probe` — process-global hatch STATE that is not an object (the
+  faults module's armed one-shot): ``check()`` returning truthy at guard
+  time is a leak; ``closer()`` clears it.
+
+Pure stdlib, threadsafe (track/untrack run on worker threads), no JAX —
+importable by the analysis layer and by every threaded subsystem without
+ordering hazards.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+_lock = threading.Lock()
+# id(handle) -> (kind, name, closer, handle).  The handle reference is
+# deliberately strong: an owner that drops its last reference without
+# closing is exactly the leak the registry exists to surface.
+_LIVE: Dict[int, Tuple[str, str, Callable[[], None], object]] = {}
+_PROBES: List[Tuple[str, Callable[[], bool], Callable[[], None]]] = []
+
+
+def track(kind: str, handle: object, closer: Callable[[], None],
+          name: Optional[str] = None) -> object:
+    """Register a live thread-owning object.  Returns ``handle`` so the
+    call can wrap a constructor expression.  Re-tracking the same handle
+    replaces its entry (idempotent)."""
+    with _lock:
+        _LIVE[id(handle)] = (str(kind), name or type(handle).__name__,
+                             closer, handle)
+    return handle
+
+
+def untrack(handle: object) -> None:
+    """Deregister (idempotent — closing twice must not raise)."""
+    with _lock:
+        _LIVE.pop(id(handle), None)
+
+
+def tracked(handle: object) -> bool:
+    with _lock:
+        return id(handle) in _LIVE
+
+
+def probe(kind: str, check: Callable[[], bool],
+          closer: Callable[[], None]) -> None:
+    """Register a process-global leak probe (module import time; never
+    deregistered — the probe's ``check`` decides liveness per call)."""
+    with _lock:
+        for i, (k, _c, _cl) in enumerate(_PROBES):
+            if k == kind:                 # module reload: replace, not stack
+                _PROBES[i] = (kind, check, closer)
+                return
+        _PROBES.append((str(kind), check, closer))
+
+
+def live(kind: Optional[str] = None) -> List[Tuple[str, str]]:
+    """Live tracked entries as (kind, name) pairs, optionally filtered."""
+    with _lock:
+        return [(k, n) for (k, n, _c, _h) in _LIVE.values()
+                if kind is None or k == kind]
+
+
+def live_count(kind: Optional[str] = None) -> int:
+    return len(live(kind))
+
+
+def leaks() -> List[Tuple[str, str, Callable[[], None]]]:
+    """Everything currently leaked: live tracked objects plus tripped
+    probes, as (kind, name, closer) — the conftest guard's one read."""
+    with _lock:
+        out = [(k, n, c) for (k, n, c, _h) in _LIVE.values()]
+        probes = list(_PROBES)
+    for kind, check, closer in probes:
+        try:
+            if check():
+                out.append((kind, kind, closer))
+        except Exception:  # a broken probe is itself a leak to surface
+            out.append((kind, kind + " (probe raised)", closer))
+    return out

@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import faults as faults_mod
-from .. import telemetry
+from .. import hatches, telemetry
 from ..utils import log
 from ..ops.scoring import add_tree_score
 from ..ops.lookup import exact_table_lookup as _leaf_lookup
@@ -550,7 +550,7 @@ class GBDT:
         per-query bagging (the atomic-query draw is a host loop)."""
         if not self._use_bagging:
             return False
-        if os.environ.get("LGBM_TPU_HOST_BAGGING", "") == "1":
+        if hatches.flag("LGBM_TPU_HOST_BAGGING"):
             return False
         mode = getattr(boosting_config, "bagging_device", "auto")
         if mode == "false":
@@ -673,8 +673,8 @@ class GBDT:
         run_training (``_pipeline_auto``); multi-process runs stay
         synchronous (replicated host inputs make deferred consumption a
         cross-host ordering hazard for no measured win)."""
-        env = os.environ.get("LGBM_TPU_PIPELINE", "")
-        mode = env if env in ("off", "readback") else getattr(
+        env = hatches.choice("LGBM_TPU_PIPELINE", ("off", "readback"))
+        mode = env or getattr(
             getattr(self, "gbdt_config", None), "pipeline", "off")
         if mode == "off":
             on = False

@@ -97,9 +97,8 @@ def pallas_partition_ok(num_features: int | None = None) -> bool:
     the XLA argsort oracle instead of failing to compile.  Every outcome
     is counted (telemetry) — the runtime record of which partition route
     the process baked into its programs."""
-    import os
-    from .. import telemetry
-    if os.environ.get("LGBM_TPU_NO_PALLAS", "") == "1":
+    from .. import hatches, telemetry
+    if hatches.flag("LGBM_TPU_NO_PALLAS"):
         # count_route: this rule is re-evaluated per tree by host code, so
         # counting per outcome CHANGE keeps the counter at per-decision
         # magnitude like the trace-time counters
@@ -304,8 +303,8 @@ def partition_overlap_on() -> bool:
     call/trace, and the program-cache key builders (gbdt/learners)
     include it so a mid-process flip retraces instead of silently
     reusing the other schedule's kernel."""
-    import os
-    return os.environ.get("LGBM_TPU_PARTITION_NO_OVERLAP", "") != "1"
+    from .. import hatches
+    return not hatches.flag("LGBM_TPU_PARTITION_NO_OVERLAP")
 
 
 def partition_segment(seg, mask3, delta, cnt, plcnt, *, block: int = BLOCK,

@@ -18,13 +18,12 @@ A ``segment_sum`` backend exists for comparison/testing; matmul is default.
 from __future__ import annotations
 
 import functools
-import os
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 
-from .. import costmodel, telemetry
+from .. import costmodel, hatches, telemetry
 
 # transient one-hot working-set budget (bytes) for the chunked matmul
 CHUNK_BYTE_BUDGET = 256 << 20
@@ -43,13 +42,13 @@ def _pallas_hist_ok(num_bins_max: int) -> bool:
     Every outcome is counted (telemetry): routing decisions are trace-time
     events baked into the compiled program, so these counters are the
     runtime record of which kernels the process's programs actually use."""
-    if os.environ.get("LGBM_TPU_HIST_EINSUM", "") == "1":
+    if hatches.flag("LGBM_TPU_HIST_EINSUM"):
         telemetry.count("hist/env_force_einsum")
         return False
     # LGBM_TPU_NO_PALLAS covers EVERY Pallas kernel (partition + these
     # histogram kernels, ops/compact.pallas_partition_ok) — the
     # mixed-backend escape hatch; HIST_EINSUM stays the A/B-timing hatch
-    if os.environ.get("LGBM_TPU_NO_PALLAS", "") == "1":
+    if hatches.flag("LGBM_TPU_NO_PALLAS"):
         telemetry.count("hist/env_no_pallas")
         return False
     ok = jax.default_backend() == "tpu" and num_bins_max <= 256

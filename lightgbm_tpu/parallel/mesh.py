@@ -34,7 +34,8 @@ def init_distributed(config=None) -> None:
     client state directly instead of jax.process_count() (which would
     itself initialize the backend and make initialize() impossible).
     """
-    coordinator = os.environ.get("LGBM_TPU_COORDINATOR")
+    from .. import hatches
+    coordinator = hatches.raw("LGBM_TPU_COORDINATOR")
     if not coordinator:
         return
     try:
@@ -48,8 +49,8 @@ def init_distributed(config=None) -> None:
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator,
-            num_processes=int(os.environ.get("LGBM_TPU_NUM_PROCS", "1")),
-            process_id=int(os.environ.get("LGBM_TPU_PROC_ID", "0")))
+            num_processes=hatches.int_value("LGBM_TPU_NUM_PROCS", 1),
+            process_id=hatches.int_value("LGBM_TPU_PROC_ID", 0))
     except RuntimeError as e:
         # the public double-initialization signal ("distributed.initialize
         # should only be called once." in jax 0.9; older builds said

@@ -74,7 +74,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from . import costmodel, telemetry
+from . import costmodel, lifecycle, telemetry
 
 DEFAULT_BUCKETS: Tuple[int, ...] = (1, 32, 1024, 65536)
 
@@ -629,6 +629,11 @@ class ServingFront:
         self._thread = threading.Thread(target=self._serve_loop,
                                         name="lgbm-serving-front",
                                         daemon=True)
+        # shared live-object inventory (ISSUE 15): a test that leaks the
+        # front's worker thread used to be invisible to the conftest
+        # guard — the registry makes the guard and graftlint C1 read one
+        # list
+        lifecycle.track("serving-front", self, self.close)
         self._thread.start()
 
     @property
@@ -723,6 +728,11 @@ class ServingFront:
             self._closed = True
             self._cond.notify_all()
         self._thread.join(timeout)
+        # a worker wedged on a hung device dispatch stays REGISTERED — the
+        # leak guard exists to surface exactly that (same contract as
+        # CheckpointWriter.close)
+        if not self._thread.is_alive():
+            lifecycle.untrack(self)
         telemetry.count("serve/queue_peak_rows",
                         self.stats["queue_peak_rows"])
 
@@ -804,8 +814,16 @@ class ServingFront:
                 scores = engine.scores(feats)
             except BaseException as e:  # surfaced per request, never lost
                 for r in batch:
-                    if not (r.future.cancelled() or r.future.done()):
-                        r.future.set_exception(e)
+                    # same check→set race as delivery below: a client
+                    # cancelling between the check and the set raises
+                    # InvalidStateError, which would kill THIS worker
+                    # loop and wedge every later request (the PR 13 bug
+                    # class, graftlint C2)
+                    try:
+                        if not (r.future.cancelled() or r.future.done()):
+                            r.future.set_exception(e)
+                    except Exception:
+                        pass
                 continue
             ofs = 0
             for r in batch:

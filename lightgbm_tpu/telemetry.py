@@ -175,9 +175,152 @@ import time
 import traceback
 from typing import Dict, List, Optional
 
+from . import lifecycle
+
 # Canonical per-iteration phase keys — always present in iteration records
 # (ISSUE 1 acceptance schema), whether or not the phase ran this iteration.
 CANONICAL_PHASES = ("histogram", "split_find", "partition", "eval")
+
+# --------------------------------------------------------------------------
+# Telemetry name inventory (ISSUE 15) — THE machine-checked family
+# documentation, regenerated from the graftlint D1 census
+# (analysis/drift_rules.collect_telemetry_usage; ``python
+# scripts/graftlint.py --drift-only`` reports any drift).  The prose
+# docstring above explains each family's semantics; THESE tuples are the
+# name contract: a counter/span/wire-site the code emits but this
+# inventory omits — or an entry here no code emits — fails the pre-merge
+# gate.  Entries ending in ``*`` are prefix families whose suffix is
+# computed at runtime (bucket sizes, kernel widths, per-host keys).
+
+COUNTER_FAMILIES = (
+    "allhosts/*",                 # cross-host sums (aggregate_telemetry)
+    "bagging/device",
+    "bagging/host",
+    "ckpt/async_write_us",
+    "ckpt/dropped",
+    "ckpt/pruned",
+    "ckpt/restored",
+    "ckpt/snapshots",
+    "ckpt/written",
+    "costmodel/aot_call_fallback",
+    "costmodel/capture_failed",
+    "elastic/shrinks",
+    "goss/iterations",
+    "health/*",                   # per-anomaly-kind counters (health.py)
+    "health/anomalous_iterations",
+    "hist/env_force_einsum",
+    "hist/env_no_pallas",
+    "hist/mixedbin_blocked",
+    "hist/mixedbin_leafbatch",
+    "hist/mixedbin_matmul",
+    "hist/mixedbin_off",
+    "hist/mixedbin_on",
+    "hist/mixedbin_pallas_float",
+    "hist/mixedbin_pallas_int",
+    "hist/mixedbin_xla_int",
+    "hist/pallas_*",              # per-dtype kernel hits
+    "hist/pallas_eligible",
+    "hist/pallas_ineligible",
+    "hist/pallas_int8",
+    "hist/pallas_kernel_*",       # per-width kernel-class hits
+    "hist/xla_einsum",
+    "hist/xla_int8",
+    "hist/xla_int_kernel",
+    "hist/xla_matmul",
+    "ingest/chunks",
+    "ingest/double_buffer_off",
+    "ingest/double_buffer_on",
+    "ingest/h2d_bytes",
+    "ingest/h2d_wait_us",
+    "ingest/overlap_hidden_us",
+    "ingest/rows",
+    "jit/backend_compile",
+    "jit/midrun_recompile",
+    "jit/persistent_cache_hit",
+    "learner/fp_*",               # feature-parallel ownership routes
+    "partition/dma_overlap",
+    "partition/dma_serial",
+    "partition/env_no_pallas",
+    "partition/pallas",
+    "partition/pallas_eligible",
+    "partition/pallas_ineligible",
+    "partition/wide_f_fallback",
+    "partition/xla",
+    "serve/bucket_*",             # per-ladder-bucket dispatch counts
+    "serve/coalesced_batches",
+    "serve/coalesced_requests",
+    "serve/coalesced_rows",
+    "serve/ensemble_flatten",
+    "serve/front_requests",
+    "serve/front_rows",
+    "serve/linger_wait_us",
+    "serve/pad_rows",
+    "serve/predict_calls",
+    "serve/queue_depth_rows",
+    "serve/queue_depth_samples",
+    "serve/queue_peak_rows",
+    "serve/rows",
+    "serve/swap_drain_us",
+    "serve/swaps",
+    "serve/warmups",
+)
+
+SPAN_FAMILIES = (
+    "bagging",
+    "elastic",
+    "eval",
+    "goss",
+    "gradient",
+    "grow",
+    "histogram",
+    "ingest",
+    "ingest_bin",
+    "ingest_count",
+    "ingest_h2d",
+    "ingest_pass1",
+    "model_readback",
+    "partition",
+    "predict",
+    "predict_encode",
+    "predict_warmup",
+    "score_update",
+    "split_find",
+    "train_chunk",
+    "valid_update",
+)
+
+WIRE_SITE_FAMILIES = (
+    "dp/grad_score_allgather",
+    "elastic/survivor_pmin",
+    "elastic/times_allgather",
+    "health/quant_sat_reduce",
+    "health/score_pmax",
+    "health/vector_psum",
+    "hist/int8_pallas_psum",
+    "hist/int8_segsum_psum",
+    "hist/int8_xla_psum",
+    "hist/quant_scale_pmax",
+    "leafcompact/tier_pmax",
+    "serve/tree_carry",
+    "serve/tree_psum",
+)
+
+# Wire sites whose full names are built at RUNTIME (variable site labels
+# threaded through the learners' seam wrappers) — documented here, exempt
+# from the stale-doc half of the D1 census the static AST pass cannot
+# decide.  The J2 census and tests/test_graftlint.EXPECTED_SITES pin the
+# concrete (2,2)-mesh instances.
+DYNAMIC_WIRE_SITES = (
+    "dp_psum/*",                  # pure-DP psum schedule seams
+    "dp_rs/*",                    # DP reduce_scatter ownership seams
+    "dp/goss_score_allgather",    # fused-chunk GOSS score gather
+    "hybrid/*",                   # 2-D mesh owned-block seams
+    "voting/*",                   # PV-tree voted-exchange seams
+    "fp/*",                       # feature-parallel ownership seams
+    "leafwise/*",                 # schedule-policy seam wrap (grower)
+    "depthwise/*",
+    "leafcompact/*",
+)
 
 _enabled = False
 _fence = False
@@ -760,6 +903,9 @@ def arm_watchdog(timeout_s: Optional[float] = None, clock=None,
     _wd_thread = threading.Thread(
         target=_wd_run, args=(_wd_stop, poll_s), name="lgbm-tpu-watchdog",
         daemon=True)
+    # shared live-object inventory (ISSUE 15): the guard and graftlint C1
+    # see the watchdog like every other thread-owning subsystem
+    lifecycle.track("watchdog", _wd_thread, disarm_watchdog)
     _wd_thread.start()
     _update_ring_armed()
     return True
@@ -772,8 +918,11 @@ def disarm_watchdog(join_s: float = 2.0) -> None:
     _update_ring_armed()
     if ev is not None:
         ev.set()
-    if t is not None and t.is_alive():
-        t.join(join_s)
+    if t is not None:
+        if t.is_alive():
+            t.join(join_s)
+        if not t.is_alive():
+            lifecycle.untrack(t)
 
 
 def watchdog_active() -> bool:

@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""graftlint — static AST + jaxpr invariant analyzer (pre-merge gate).
+"""graftlint — static AST + jaxpr + concurrency/drift analyzer (gate).
 
 Runs beside ``scripts/perf_gate.py --check`` with the same exit-code
 contract (0 clean / 1 findings / 2 tool error):
@@ -13,8 +13,12 @@ banned-patterns-in-traced-code); Layer 2 traces the canonical
 small-schema programs (serial/DP/hybrid/voting grow, serving BFS, the
 int8 histogram exchange) under ``JAX_PLATFORMS=cpu`` and walks their
 closed jaxprs (J1 dtype discipline, J2 collective census vs the declared
-telemetry seam inventory).  Findings print ``path:line RULE [symbol]
-site: message — fix: hint``.
+telemetry seam inventory).  Layer 3 (ISSUE 15, no JAX needed) covers
+the threaded subsystems (C1 thread-lifecycle-registration, C2
+future-set-race, C3 blocking-under-lock, C4 env-hatch-discipline) and
+the cross-artifact drift censuses (D1 telemetry name families, D2
+perf_gate key coverage, D3 the CLI knob inventory).  Findings print
+``path:line RULE [symbol] site: message — fix: hint``.
 
 Accepted sites are suppressed EXPLICITLY in ``GRAFTLINT_BASELINE.json``
 (each entry carries a written justification; ``--explain-allowlist``
@@ -46,12 +50,18 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--check", action="store_true",
-                   help="run both layers against the baseline (the "
+                   help="run every layer against the baseline (the "
                         "pre-merge gate; this is also the default)")
     p.add_argument("--ast-only", action="store_true",
                    help="layer 1 only (no JAX import — runs anywhere)")
     p.add_argument("--jaxpr-only", action="store_true",
                    help="layer 2 only (traces the canonical programs)")
+    p.add_argument("--concurrency-only", action="store_true",
+                   help="layer 3 C-rules only (thread/Future lifecycle "
+                        "+ env-hatch discipline; no JAX import)")
+    p.add_argument("--drift-only", action="store_true",
+                   help="layer 3 D-rules only (telemetry/perf_gate/knob "
+                        "cross-artifact censuses; no JAX import)")
     p.add_argument("--baseline", metavar="PATH", default=None,
                    help="baseline/allowlist file (default: "
                         "GRAFTLINT_BASELINE.json at the repo root)")
@@ -87,12 +97,11 @@ def main(argv=None) -> int:
                      e.get("site", "*"), e["justification"]))
         return 0
 
-    if args.ast_only:
-        layers = ("ast",)
-    elif args.jaxpr_only:
-        layers = ("jaxpr",)
-    else:
-        layers = ("ast", "jaxpr")
+    selected = [layer for layer, on in (
+        ("ast", args.ast_only), ("jaxpr", args.jaxpr_only),
+        ("concurrency", args.concurrency_only),
+        ("drift", args.drift_only)) if on]
+    layers = tuple(selected) or driver.ALL_LAYERS
 
     try:
         report = driver.run(layers=layers, baseline=baseline)

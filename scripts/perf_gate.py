@@ -98,6 +98,10 @@ RATE_KEYS: Tuple[Tuple[str, str], ...] = (
     ("predict_b65536_rows_per_sec", "predict_b65536_spread"),
     ("predict_int8_b65536_rows_per_sec", "predict_int8_b65536_spread"),
     ("predict_b1024_rows_per_sec", "predict_b1024_spread"),
+    # the 32-row latency-tier bucket: recorded with a spread marker
+    # since r06 but never gated — the exact stale-emission drift the
+    # graftlint D2 census now fails the gate on (ISSUE 15)
+    ("predict_b32_rows_per_sec", "predict_b32_spread"),
     # streaming ingestion (ISSUE 8, bench.py --bench-ingest): rows/sec
     # for the chunked parse->bin->HBM pipeline.  The double-buffer A/B,
     # H2D GB/s and the peak-RSS assertion ride the record ungated

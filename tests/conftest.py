@@ -41,11 +41,9 @@ def _telemetry_leak_guard():
     yield
     leaked_enabled = telemetry.enabled()
     leaked_sink = telemetry.sink_open()
-    # ISSUE 5 surfaces: a live watchdog thread keeps polling (and could
-    # dump into a LATER test's sink); timeline/shard mode left on makes
-    # the next metrics_out test write an unexpected shard file instead
-    # of its configured path (an unmerged shard surviving the test)
-    leaked_watchdog = telemetry.watchdog_active()
+    # ISSUE 5 surface: timeline/shard mode left on makes the next
+    # metrics_out test write an unexpected shard file instead of its
+    # configured path (an unmerged shard surviving the test)
     leaked_timeline = telemetry.timeline_enabled()
     # ISSUE 10 surface: graftlint's jaxpr layer arms telemetry in
     # trace-census mode (analysis.jaxpr_rules.begin_census) to record
@@ -57,21 +55,24 @@ def _telemetry_leak_guard():
     leaked_census = _graftlint_census.trace_census_active()
     if leaked_census:
         _graftlint_census.end_census()
-    # ISSUE 14 surfaces: a live async checkpoint writer keeps writing
-    # into a (possibly torn-down) tmpdir after the test ends; an armed
-    # fault-injection hatch (programmatic or env) would kill/stall a
-    # LATER test's training loop at its configured iteration.  Check,
-    # then clean up so the rest of the suite runs unpoisoned.
-    from lightgbm_tpu import checkpoint as _ckpt_mod
-    from lightgbm_tpu import faults as _faults_mod
-    leaked_ckpt_writers = _ckpt_mod.live_writers()
-    if leaked_ckpt_writers:
-        for w in list(_ckpt_mod._LIVE_WRITERS):
-            w.close()
-    leaked_fault = _faults_mod.armed()
-    if leaked_fault:
-        _faults_mod.disarm()
-        os.environ.pop(_faults_mod.ENV_VAR, None)
+    # ISSUE 15: every thread-owning subsystem (checkpoint writers, the
+    # serving front, prefetch threads, the telemetry watchdog) and the
+    # armed fault hatch register with ONE shared inventory
+    # (lightgbm_tpu/lifecycle.py) — the guard reads it here instead of
+    # hand-enumerating per module, and graftlint C1 gates that every new
+    # thread spawn site keeps registering.  Read BEFORE the disable
+    # below (disable() disarms — and deregisters — the watchdog).
+    from lightgbm_tpu import faults as _faults  # noqa: F401 — importing
+    # registers its armed-hatch probe; without this a test that set
+    # LGBM_TPU_FAULT_AT without ever importing faults would slip past
+    # the guard and SIGKILL a LATER test's training loop
+    from lightgbm_tpu import lifecycle as _lifecycle
+    leaked_objects = _lifecycle.leaks()
+    for _kind, _name, _closer in leaked_objects:
+        try:
+            _closer()
+        except Exception:
+            pass
     telemetry.disable()
     telemetry.reset()
     # ISSUE 9 surface: a test that enters ``with mesh:`` and leaks it
@@ -90,19 +91,18 @@ def _telemetry_leak_guard():
             _mesh_lib.thread_resources.env = _mesh_lib.EMPTY_ENV
     except (ImportError, AttributeError):  # pragma: no cover - jax drift
         pass
-    assert not (leaked_enabled or leaked_sink or leaked_watchdog
-                or leaked_timeline or leaked_census
-                or leaked_ckpt_writers or leaked_fault
+    assert not (leaked_enabled or leaked_sink or leaked_timeline
+                or leaked_census or leaked_objects
                 or leaked_mesh is not None), (
         "test left %s — clean up (telemetry.disable() / end_census() / "
-        "CheckpointWriter.close() / faults.disarm() / exit the mesh "
-        "context, or use a fixture) so state cannot leak between tests"
-        % ("telemetry with a live watchdog thread" if leaked_watchdog
+        "close()/disarm the leaked object / exit the mesh context, or "
+        "use a fixture) so state cannot leak between tests"
+        % ("live lifecycle registrations: %s"
+           % ", ".join(sorted("%s(%s)" % (k, n)
+                              for k, n, _c in leaked_objects))
+           if leaked_objects
            else "telemetry in timeline/shard mode" if leaked_timeline
            else "graftlint trace-census armed" if leaked_census
-           else "%d checkpoint writer thread(s) alive"
-                % leaked_ckpt_writers if leaked_ckpt_writers
-           else "a fault-injection hatch armed" if leaked_fault
            else "telemetry enabled with an open sink" if leaked_sink
            else "telemetry enabled" if leaked_enabled
            else "a global mesh context installed (%r)" % (leaked_mesh,)))

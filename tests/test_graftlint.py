@@ -472,6 +472,554 @@ def test_trace_census_refuses_to_destroy_a_live_registry(jax_mod):
         telemetry.reset()
 
 
+# ============================== C1-C4: concurrency-lifecycle (ISSUE 15)
+
+from lightgbm_tpu.analysis.concurrency_rules import (ConcurrencyConfig,
+                                                     run_concurrency_rules)
+
+
+def _clint(src, path="fix_c.py", **cfg):
+    return run_concurrency_rules(
+        {path: textwrap.dedent(src)},
+        ConcurrencyConfig(**cfg) if cfg else ConcurrencyConfig(
+            hatch_inventory=set()))
+
+
+C1_BAD_CLASS = """
+import threading
+
+class Pump:
+    def __init__(self):
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+
+    def close(self):
+        self._t.join()
+"""
+
+C1_BAD_NO_CLOSE = """
+import threading
+
+class FireAndForget:
+    def __init__(self):
+        threading.Thread(target=self._run, daemon=True).start()
+"""
+
+C1_OK_CLASS = """
+import threading
+from lightgbm_tpu import lifecycle
+
+class Pump:
+    def __init__(self):
+        self._t = threading.Thread(target=self._run, daemon=True)
+        lifecycle.track("pump", self, self.close)
+        self._t.start()
+
+    def close(self):
+        self._t.join()
+        lifecycle.untrack(self)
+"""
+
+C1_BAD_BARE = """
+import threading
+
+def prefetch(it):
+    threading.Thread(target=lambda: list(it), daemon=True).start()
+"""
+
+C1_OK_BARE = """
+import threading
+from lightgbm_tpu import lifecycle
+
+def prefetch(it):
+    t = threading.Thread(target=lambda: list(it), daemon=True)
+    lifecycle.track("prefetch", t, t.join)
+    t.start()
+"""
+
+
+def test_c1_fires_on_unregistered_thread_class():
+    (f,) = _clint(C1_BAD_CLASS)
+    assert f.rule == "C1" and f.line == 6
+    assert "lifecycle.track" in f.message and "Pump" in f.message
+
+
+def test_c1_fires_on_class_without_close_entry_point():
+    (f,) = _clint(C1_BAD_NO_CLOSE)
+    assert f.rule == "C1" and "close" in f.message
+
+
+def test_c1_clean_on_registered_class_with_close():
+    assert _clint(C1_OK_CLASS) == []
+
+
+def test_c1_bare_function_spawn_needs_track_in_same_function():
+    (f,) = _clint(C1_BAD_BARE)
+    assert f.rule == "C1" and f.symbol == "prefetch"
+    assert _clint(C1_OK_BARE) == []
+
+
+C2_BAD = """
+def deliver(batch, scores):
+    ofs = 0
+    for r in batch:
+        if not r.future.cancelled():
+            r.future.set_result(scores[:, ofs:ofs + r.rows])
+        ofs += r.rows
+"""
+
+C2_OK = """
+def deliver(batch, scores):
+    ofs = 0
+    for r in batch:
+        try:
+            if not r.future.cancelled():
+                r.future.set_result(scores[:, ofs:ofs + r.rows])
+        except Exception:
+            pass
+        ofs += r.rows
+
+def fail(batch, e):
+    for r in batch:
+        try:
+            r.future.set_exception(e)
+        except (RuntimeError, InvalidStateError):
+            pass
+"""
+
+
+def test_c2_fires_on_unguarded_future_set():
+    # the cancelled() pre-check is NOT enough: the check->set window IS
+    # the race (the exact PR 13 ServingFront bug, generalized)
+    (f,) = _clint(C2_BAD)
+    assert f.rule == "C2" and f.line == 6 and f.site == ".set_result"
+
+
+def test_c2_clean_when_set_rides_an_absorbing_try():
+    assert _clint(C2_OK) == []
+
+
+C3_BAD = """
+import time
+
+class Front:
+    def flush(self):
+        with self._cond:
+            self._cond.wait(0.05)
+            self._thread.join()
+            time.sleep(0.5)
+            data = open(self.path).read()
+            self._queue.put(data)
+        return data
+"""
+
+C3_OK = """
+class Front:
+    def flush(self):
+        with self._cond:
+            while self._pending is None and not self._closing:
+                self._cond.wait()
+            item, self._pending = self._pending, None
+            self._cond.notify_all()
+        self._io.write(item)
+        self._thread.join()
+
+    def drain(self):
+        with self._cond:
+            self._queue.put(1, timeout=0.1)
+            got = self._table.get("key")
+"""
+
+
+def test_c3_fires_on_each_blocking_op_under_the_lock():
+    found = _clint(C3_BAD)
+    sites = {f.site for f in found}
+    assert all(f.rule == "C3" for f in found)
+    assert {"self._thread.join", "time.sleep", "open",
+            "self._queue.put"} <= sites
+    # cv.wait on the lock object itself is exempt (wait RELEASES it)
+    assert not any("cond" in s for s in sites)
+
+
+def test_c3_clean_on_lock_waits_timed_queue_ops_and_outside_io():
+    assert _clint(C3_OK) == []
+
+
+C4_BAD_RAW = """
+import os
+
+def no_pallas():
+    return os.environ.get("LGBM_TPU_NO_PALLAS", "") == "1"
+"""
+
+C4_BAD_ALIAS = """
+import os
+ENV_VAR = "LGBM_TPU_FAULT_AT"
+
+def spec():
+    return os.environ.get(ENV_VAR)
+"""
+
+C4_BAD_UNREGISTERED = """
+from lightgbm_tpu import hatches
+
+def ghost():
+    return hatches.flag("LGBM_TPU_GHOST")
+"""
+
+C4_OK = """
+from lightgbm_tpu import hatches
+
+def no_pallas():
+    return hatches.flag("LGBM_TPU_NO_PALLAS")
+"""
+
+
+def test_c4_fires_on_raw_env_read():
+    (f,) = _clint(C4_BAD_RAW)
+    assert f.rule == "C4" and f.site == "LGBM_TPU_NO_PALLAS"
+    assert f.line == 5
+
+
+def test_c4_resolves_module_constant_aliases():
+    (f,) = _clint(C4_BAD_ALIAS)
+    assert f.rule == "C4" and f.site == "LGBM_TPU_FAULT_AT"
+
+
+def test_c4_fires_on_helper_read_missing_from_inventory():
+    (f,) = _clint(C4_BAD_UNREGISTERED,
+                  hatch_inventory={"LGBM_TPU_NO_PALLAS"})
+    assert f.rule == "C4" and f.site == "LGBM_TPU_GHOST"
+    assert "inventory" in f.message
+
+
+def test_c4_clean_on_registered_helper_read():
+    assert _clint(C4_OK, hatch_inventory={"LGBM_TPU_NO_PALLAS"}) == []
+
+
+def test_hatches_helper_loud_rejects(monkeypatch):
+    """The runtime half of C4: a typo'd hatch VALUE must reject, not
+    silently do nothing."""
+    from lightgbm_tpu import hatches
+    from lightgbm_tpu.utils import log
+    monkeypatch.setenv("LGBM_TPU_NO_PALLAS", "true")
+    with pytest.raises(log.LightGBMError):
+        hatches.flag("LGBM_TPU_NO_PALLAS")
+    monkeypatch.setenv("LGBM_TPU_NO_PALLAS", "1")
+    assert hatches.flag("LGBM_TPU_NO_PALLAS") is True
+    monkeypatch.delenv("LGBM_TPU_NO_PALLAS")
+    assert hatches.flag("LGBM_TPU_NO_PALLAS") is False
+    with pytest.raises(log.LightGBMError):
+        hatches.flag("LGBM_TPU_UNREGISTERED_GHOST")
+
+
+# =============================== D1-D3: cross-artifact drift (ISSUE 15)
+
+from lightgbm_tpu.analysis import drift_rules
+
+
+D1_FILES_OK = {
+    "pkg/serving.py": textwrap.dedent("""
+        from . import telemetry
+        def go(n):
+            telemetry.count("serve/rows", n)
+            telemetry.count(f"serve/bucket_{n}")
+            with telemetry.span("predict"):
+                telemetry.record_collective("serve/tree_psum", "psum",
+                                            "tree", 4)
+    """),
+}
+D1_INV_OK = {
+    "counter": ("serve/rows", "serve/bucket_*"),
+    "span": ("predict",),
+    "wire": ("serve/tree_psum",),
+    "dynamic": (),
+}
+
+
+def test_d1_clean_when_census_matches_inventory():
+    assert drift_rules.check_telemetry_inventory(
+        D1_FILES_OK, D1_INV_OK, telemetry_path="pkg/telemetry.py") == []
+
+
+def test_d1_fires_on_undocumented_usage():
+    # deleting a documented family line makes the census fire — the
+    # acceptance-criteria liveness direction
+    inv = dict(D1_INV_OK, counter=("serve/bucket_*",))
+    found = drift_rules.check_telemetry_inventory(
+        D1_FILES_OK, inv, telemetry_path="pkg/telemetry.py")
+    assert any(f.rule == "D1" and f.site == "serve/rows"
+               and f.path == "pkg/serving.py" and f.line == 4
+               for f in found)
+
+
+def test_d1_fires_on_stale_documentation():
+    inv = dict(D1_INV_OK, span=("predict", "ghost_span"))
+    found = drift_rules.check_telemetry_inventory(
+        D1_FILES_OK, inv, telemetry_path="pkg/telemetry.py")
+    assert any(f.rule == "D1" and f.site == "ghost_span"
+               and "stale" in f.message for f in found)
+
+
+def test_d1_real_inventory_census_is_live():
+    """Acceptance: deleting any one STATIC documented telemetry family
+    line from the real inventory makes the census (and therefore
+    ``--check``) flag it."""
+    from lightgbm_tpu import telemetry
+    files = {p: open(p).read()
+             for p in glob.glob(os.path.join(
+                 REPO, "lightgbm_tpu", "**", "*.py"), recursive=True)}
+    tel_path = next(p for p in files if p.endswith("telemetry.py"))
+    for dropped in ("serve/swaps", "ckpt/written"):
+        inv = {
+            "counter": tuple(n for n in telemetry.COUNTER_FAMILIES
+                             if n != dropped),
+            "span": telemetry.SPAN_FAMILIES,
+            "wire": telemetry.WIRE_SITE_FAMILIES,
+            "dynamic": telemetry.DYNAMIC_WIRE_SITES,
+        }
+        found = drift_rules.check_telemetry_inventory(
+            files, inv, telemetry_path=tel_path)
+        assert any(f.rule == "D1" and f.site == dropped
+                   for f in found), dropped
+
+
+D2_GATES_OK = {
+    "RATE_KEYS": (("value", "spread"), ("x_rows_per_sec", "x_spread")),
+    "LATENCY_KEYS": (("x_p99_us", "x_spread"),),
+    "ABSOLUTE_ZERO_KEYS": (("x_recompiles", "d"),),
+    "ABSOLUTE_TRUE_KEYS": (("x_restore_exact", "d"),),
+    "_source": "",
+}
+D2_BENCH_OK = ('out = {"value": 1, "spread": 0, "x_rows_per_sec": 2,'
+               ' "x_spread": 0, "x_p99_us": 3, "x_recompiles": 0,'
+               ' "x_restore_exact": True}')
+
+
+def test_d2_clean_when_gates_cover_emissions():
+    assert drift_rules.check_perf_gate_coverage(
+        D2_GATES_OK, D2_BENCH_OK, informational={}) == []
+
+
+def test_d2_fires_on_stale_gate_key():
+    gates = dict(D2_GATES_OK,
+                 RATE_KEYS=D2_GATES_OK["RATE_KEYS"]
+                 + (("ghost_rows_per_sec", "ghost_spread"),))
+    found = drift_rules.check_perf_gate_coverage(gates, D2_BENCH_OK,
+                                                 informational={})
+    assert {f.site for f in found} == {"ghost_rows_per_sec",
+                                       "ghost_spread"}
+    assert all("gates nothing" in f.message for f in found)
+
+
+def test_d2_fires_on_ungated_emission():
+    # deleting a gate key whose lane bench still emits — the acceptance
+    # liveness direction
+    gates = dict(D2_GATES_OK, RATE_KEYS=(("value", "spread"),),
+                 LATENCY_KEYS=())
+    found = drift_rules.check_perf_gate_coverage(gates, D2_BENCH_OK,
+                                                 informational={})
+    sites = {f.site for f in found}
+    assert {"x_rows_per_sec", "x_spread", "x_p99_us"} <= sites
+
+
+def test_d2_real_gate_census_is_live():
+    """Acceptance: deleting any one perf_gate key pair while bench.py
+    still emits the lane makes the census flag it."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "_pg_test", os.path.join(REPO, "scripts", "perf_gate.py"))
+    pg = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(pg)
+    bench_src = open(os.path.join(REPO, "bench.py")).read()
+    gates = {
+        "RATE_KEYS": tuple(p for p in pg.RATE_KEYS
+                           if p[0] != "ingest_rows_per_sec"),
+        "LATENCY_KEYS": pg.LATENCY_KEYS,
+        "ABSOLUTE_ZERO_KEYS": pg.ABSOLUTE_ZERO_KEYS,
+        "ABSOLUTE_TRUE_KEYS": pg.ABSOLUTE_TRUE_KEYS,
+        "_source": "",
+    }
+    found = drift_rules.check_perf_gate_coverage(gates, bench_src)
+    assert any(f.rule == "D2" and f.site == "ingest_rows_per_sec"
+               for f in found)
+
+
+D3_CONFIG_OK = """
+import dataclasses
+from .utils import log
+
+@dataclasses.dataclass
+class IOConfig:
+    max_bin: int = 256
+    mode: str = "auto"
+
+    def set(self, params):
+        self.max_bin = _get_int(params, "max_bin", self.max_bin)
+        if "mode" in params:
+            value = params["mode"].lower()
+            log.check(value in ("auto", "x"), "mode must be auto or x")
+            self.mode = value
+"""
+
+D3_CLI_OK = """
+KNOB_INVENTORY = {
+    "max_bin": "max bins per feature",
+    "mode": "auto or x",
+}
+"""
+
+
+def test_d3_clean_on_matching_inventory():
+    assert drift_rules.check_knob_inventory(
+        textwrap.dedent(D3_CONFIG_OK), textwrap.dedent(D3_CLI_OK),
+        freeform={}, internal={}) == []
+
+
+def test_d3_fires_on_undocumented_knob_and_stale_entry():
+    cli = 'KNOB_INVENTORY = {"max_bin": "x", "ghost_knob": "gone"}'
+    found = drift_rules.check_knob_inventory(
+        textwrap.dedent(D3_CONFIG_OK), cli, freeform={}, internal={})
+    sites = {(f.site, f.symbol) for f in found}
+    assert ("mode", "set") in sites          # undocumented knob
+    assert ("ghost_knob", "cli") in sites    # stale inventory entry
+
+
+def test_d3_fires_on_unvalidated_knob_and_unreachable_field():
+    src = """
+import dataclasses
+
+@dataclasses.dataclass
+class IOConfig:
+    path: str = ""
+    orphan: int = 0
+
+    def set(self, params):
+        self.path = _get_str(params, "path", self.path)
+"""
+    cli = 'KNOB_INVENTORY = {"path": "a path"}'
+    found = drift_rules.check_knob_inventory(
+        textwrap.dedent(src), cli, freeform={}, internal={})
+    assert any(f.site == "path" and "silently" in f.message
+               for f in found)
+    assert any(f.site == "orphan" and "unreachable" in f.message
+               for f in found)
+    # the same free-form knob with a written justification passes
+    found2 = drift_rules.check_knob_inventory(
+        textwrap.dedent(src), cli,
+        freeform={"path": "output path; open() surfaces failures"},
+        internal={"orphan": "derived"})
+    assert found2 == []
+
+
+# ==================== tier-1 gates: layers 3a/3b clean on the tree
+
+def test_concurrency_layer_clean_on_shipped_tree():
+    """The tier-1 C-rule gate: zero findings over the whole package
+    against the committed (empty) baseline — the in-suite mirror of
+    ``python scripts/graftlint.py --concurrency-only``."""
+    baseline = Baseline.load(default_baseline_path())
+    findings, _sup = split_baseline(
+        gl_driver.run_concurrency_layer(), baseline)
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_drift_layer_clean_on_shipped_tree():
+    """The tier-1 D-rule gate: the telemetry inventory, perf_gate key
+    coverage and CLI knob inventory all census clean."""
+    baseline = Baseline.load(default_baseline_path())
+    findings, _sup = split_baseline(gl_driver.run_drift_layer(), baseline)
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_graftlint_script_all_four_layers_exit_zero():
+    """ISSUE 15 acceptance: ``scripts/graftlint.py --check`` exits 0
+    over ast+jaxpr+concurrency+drift with the EMPTY committed
+    baseline."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "graftlint.py"),
+         "--check"],
+        capture_output=True, text=True, cwd=REPO, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "ast+jaxpr+concurrency+drift" in r.stdout
+
+
+def test_stale_baseline_reported_for_new_rule_ids(tmp_path):
+    """The stale-suppression finding covers the C/D ids too: an entry
+    naming a C1/D2 site that matches nothing must flag."""
+    bad = tmp_path / "stale_cd.json"
+    bad.write_text(json.dumps({"version": 1, "suppressions": [
+        {"rule": "C1", "path": "nowhere.py", "symbol": "ghost",
+         "justification": "obsolete"},
+        {"rule": "D2", "path": "bench.py", "symbol": "bench",
+         "site": "ghost_rows_per_sec", "justification": "obsolete"}]}))
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "graftlint.py"),
+         "--concurrency-only", "--drift-only", "--baseline", str(bad)],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert r.stdout.count("STALE BASELINE") == 2
+
+
+# ================== shared lifecycle inventory (ISSUE 15 satellite)
+
+def test_lifecycle_tracks_and_reports_leaks():
+    from lightgbm_tpu import lifecycle
+
+    class Obj:
+        closed = False
+
+        def close(self):
+            self.closed = True
+            lifecycle.untrack(self)
+
+    o = Obj()
+    lifecycle.track("test-kind", o, o.close)
+    assert lifecycle.live_count("test-kind") == 1
+    assert any(k == "test-kind" for k, _n, _c in lifecycle.leaks())
+    o.close()
+    assert lifecycle.live_count("test-kind") == 0
+    lifecycle.untrack(o)                      # idempotent
+
+
+def test_lifecycle_sees_leaked_checkpoint_writer(tmp_path):
+    """The conftest guard's new single read: a CheckpointWriter left
+    open appears in lifecycle.leaks() under its kind, and its closer
+    reaps it."""
+    from lightgbm_tpu import checkpoint as ckpt
+    from lightgbm_tpu import lifecycle
+    w = ckpt.CheckpointWriter(str(tmp_path))
+    assert ckpt.live_writers() == 1
+    leak = [e for e in lifecycle.leaks() if e[0] == ckpt.WRITER_KIND]
+    assert len(leak) == 1
+    leak[0][2]()                              # the guard's cleanup path
+    assert ckpt.live_writers() == 0 and not w.alive
+
+
+def test_lifecycle_sees_armed_fault_probe(monkeypatch):
+    from lightgbm_tpu import faults, lifecycle
+    faults.arm(3, "stall")
+    try:
+        assert any(k == "fault-hatch" for k, _n, _c in lifecycle.leaks())
+    finally:
+        faults.clear()
+    assert not any(k == "fault-hatch" for k, _n, _c in lifecycle.leaks())
+
+
+def test_prefetch_thread_registers_and_deregisters():
+    from lightgbm_tpu import lifecycle
+    from lightgbm_tpu.io import parser
+
+    gen = parser.prefetch_chunks(iter([[1], [2], [3]]))
+    assert next(gen) == [1]
+    # early drop: the generator's finally must stop AND deregister
+    gen.close()
+    assert lifecycle.live_count("prefetch") == 0
+    # full drain deregisters too
+    assert list(parser.prefetch_chunks(iter([[4], [5]]))) == [[4], [5]]
+    assert lifecycle.live_count("prefetch") == 0
+
+
 # ================================== baseline / suppression mechanics
 
 def test_baseline_suppresses_and_reports_stale(tmp_path):
@@ -500,7 +1048,8 @@ def test_baseline_rejects_entries_without_justification(tmp_path):
 
 
 def test_rule_catalog_covers_every_rule_id():
-    assert set(RULES) == {"R1", "R2", "R3", "R4", "J1", "J2"}
+    assert set(RULES) == {"R1", "R2", "R3", "R4", "J1", "J2",
+                          "C1", "C2", "C3", "C4", "D1", "D2", "D3"}
     for title, hint in RULES.values():
         assert title and hint
 
