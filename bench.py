@@ -20,6 +20,7 @@ from __future__ import annotations
 import argparse
 import json
 import math
+import os
 import sys
 import time
 
@@ -277,13 +278,20 @@ def bench_predict(args) -> int:
 # (perf_gate gates serve_rows_per_sec on the rate trajectory and
 # serve_p99_us on a must-not-grow lane; serve_recompiles, serve_dropped
 # and serve_misscored are ABSOLUTE findings — any nonzero fails the
-# gate with no trajectory needed)
+# gate with no trajectory needed.  ISSUE 16 adds trace_overhead_pct —
+# throughput cost of the armed flight recorder, recorder-on vs -off A/B
+# on this same lane, must-not-grow with trace_spread as its noise band —
+# and trace_dropped_at_default, ring overwrites at the DEFAULT
+# trace_ring_events during the measured windows, absolute like
+# serve_dropped)
 SERVE_COPY_KEYS = (
     "serve_rows_per_sec", "serve_spread", "serve_p50_us", "serve_p99_us",
+    "serve_p99_sketch_vs_sorted",
     "serve_offered_rows_per_sec", "serve_requests", "serve_linger_us",
     "serve_recompiles", "serve_dropped", "serve_misscored",
     "serve_swap_drain_ms", "serve_coalesced_batches",
     "serve_mean_batch_rows", "serve_shards_used",
+    "trace_overhead_pct", "trace_spread", "trace_dropped_at_default",
 )
 
 
@@ -300,9 +308,19 @@ def bench_serve(args) -> int:
     swaps to a DIFFERENT engine mid-load (drain-and-flip, double-
     buffered warmup) and counts dropped and misscored requests — both
     must be zero, and perf_gate flags any nonzero as an absolute
-    finding, like serve_recompiles."""
+    finding, like serve_recompiles.
+
+    Flight recorder (ISSUE 16): steady-phase segments run interleaved
+    recorder-ON / recorder-OFF; the ON segments (the shipped default)
+    provide the serve metrics and the OFF controls price the recorder
+    (``trace_overhead_pct``).  ``serve_p50_us``/``serve_p99_us`` are
+    computed from a streaming LatencySketch fed with the bench's own
+    per-request latencies and pinned against the sorted sample within
+    bucket resolution.  Each armed window uses a fresh DEFAULT-size
+    ring, so ``trace_dropped_at_default`` > 0 means one ~2 s window
+    overflowed the default ring — an absolute perf_gate finding."""
     import jax  # noqa: F401  (device init before timing)
-    from lightgbm_tpu import costmodel, telemetry
+    from lightgbm_tpu import costmodel, telemetry, tracing
     from lightgbm_tpu.config import OverallConfig
     from lightgbm_tpu.io.dataset import Dataset
     from lightgbm_tpu.models.gbdt import GBDT
@@ -418,25 +436,64 @@ def bench_serve(args) -> int:
             swap_thread.join(60.0)
         return records, drain_box.get("drain")
 
-    # ---- phase 1: steady open-loop load on engine A (repeats samples)
+    # ---- phase 1: steady open-loop load on engine A, interleaved
+    # recorder-ON / recorder-OFF segments (ISSUE 16).  ON segments are
+    # the shipped default-on state and provide the serve metrics; OFF
+    # segments are the control that prices the recorder.  Every ON
+    # segment arms a FRESH ring at the default size — a nonzero
+    # trace_dropped_at_default therefore means a single ~2 s window
+    # overflowed trace_ring_events, never an artifact of accumulation.
     lats, samples, requests = [], [], 0
-    for _ in range(max(1, args.repeats)):
+    off_samples = []
+    bench_sk = tracing.LatencySketch()  # bench's own submit→done lats
+    wall_sk = None                      # recorder-side serve_wall_us
+    dropped_at_default = 0
+    for rep in range(2 * max(1, args.repeats)):
+        on = rep % 2 == 0
+        if on:
+            tracing.arm()               # fresh DEFAULT-size ring
         front = ServingFront(eng_a, linger_us=linger_us)
         t0 = time.perf_counter()
         records, _ = open_loop(front, duration_s=2.0)
         front.close()
         wall = time.perf_counter() - t0
         done_rows = sum(r["n"] for r in records if "t_done" in r)
+        if not on:
+            off_samples.append(done_rows / wall)
+            continue
         samples.append(done_rows / wall)
-        lats.extend(r["t_done"] - r["t_sub"] for r in records
-                    if "t_done" in r)
+        seg_sk = tracing.LatencySketch()
+        for r in records:
+            if "t_done" in r:
+                lat = r["t_done"] - r["t_sub"]
+                lats.append(lat)
+                seg_sk.record(1e6 * lat)
+        # the cross-segment fold IS the sketch merge operator (the same
+        # count addition that folds across threads/hosts)
+        bench_sk.merge(seg_sk)
         requests += len(records)
+        dropped_at_default += tracing.dropped()
+        sk = tracing.sketch("serve_wall_us")
+        if sk is not None:
+            wall_sk = sk if wall_sk is None else wall_sk.merge(sk)
+        tracing.disarm()
 
-    # ---- phase 2: the mid-load hot swap (drain-and-flip, zero drops)
+    # ---- phase 2: the mid-load hot swap (drain-and-flip, zero drops),
+    # recorder armed so the swap/drain events land on the request
+    # timeline; --trace-dump flushes this window's ring on disarm for
+    # scripts/trace_report.py
+    if args.trace_dump:
+        os.makedirs(args.trace_dump, exist_ok=True)
+    tracing.arm(dump_dir=args.trace_dump)
     front = ServingFront(eng_a, linger_us=linger_us)
     records, drain = open_loop(front, duration_s=2.0, swap_after_s=1.0,
                                swap_to=eng_b)
     front.close()
+    dropped_at_default += tracing.dropped()
+    sk = tracing.sketch("serve_wall_us")
+    if sk is not None:
+        wall_sk = sk if wall_sk is None else wall_sk.merge(sk)
+    trace_dump_path = tracing.disarm()
     dropped = 0
     misscored = 0
     for r in records:
@@ -451,6 +508,30 @@ def bench_serve(args) -> int:
             misscored += 1
 
     med = float(np.median(samples))
+    off_med = float(np.median(off_samples)) if off_samples else med
+    # sketch percentiles, A/B-pinned against the sorted sample at the
+    # same nearest-rank convention: agreement within the sketch's bucket
+    # resolution (a factor sqrt(growth)) is a mathematical guarantee —
+    # any violation is a sketch bug and aborts the bench
+    lat_us = np.sort(np.asarray(lats)) * 1e6
+
+    def _nearest_rank(q):
+        r = min(len(lat_us) - 1, max(0, int(math.ceil(q * len(lat_us))) - 1))
+        return float(lat_us[r])
+
+    sk_p50, sk_p99 = bench_sk.quantile(0.50), bench_sk.quantile(0.99)
+    tol = math.sqrt(bench_sk.growth) * (1.0 + 1e-9)
+    for q, sk_v in ((0.50, sk_p50), (0.99, sk_p99)):
+        exact = _nearest_rank(q)
+        assert exact > 0 and 1.0 / tol <= sk_v / exact <= tol, (
+            "latency sketch p%g %.1fus vs sorted %.1fus — outside bucket "
+            "resolution (growth %g)"
+            % (100 * q, sk_v, exact, bench_sk.growth))
+
+    def _spread(vals, m):
+        return (round((max(vals) - min(vals)) / m, 4)
+                if vals and m > 0 else 0.0)
+
     out = {
         "metric": f"serve_rows_per_sec_higgs{train_rows // 1000}k_"
                   f"trees{T}_leaves{args.leaves}",
@@ -462,10 +543,11 @@ def bench_serve(args) -> int:
         "spread": round((max(samples) - min(samples)) / med, 4)
                   if med > 0 else 0.0,
         "serve_rows_per_sec": round(med, 2),
-        "serve_spread": round((max(samples) - min(samples)) / med, 4)
-                        if med > 0 else 0.0,
-        "serve_p50_us": round(1e6 * float(np.percentile(lats, 50)), 1),
-        "serve_p99_us": round(1e6 * float(np.percentile(lats, 99)), 1),
+        "serve_spread": _spread(samples, med),
+        "serve_p50_us": round(sk_p50, 1),
+        "serve_p99_us": round(sk_p99, 1),
+        "serve_p99_sketch_vs_sorted": round(sk_p99 / _nearest_rank(0.99),
+                                            4),
         "serve_offered_rows_per_sec": round(offered, 2),
         "serve_requests": requests,
         "serve_linger_us": linger_us,
@@ -482,7 +564,21 @@ def bench_serve(args) -> int:
             / max(telemetry.counters().get("serve/coalesced_batches", 1),
                   1), 1),
         "serve_shards_used": eng_a.shards,
+        # recorder cost: throughput lost with the recorder armed, from
+        # the interleaved ON/OFF medians (negative = noise; the gate's
+        # must-not-grow band absorbs it)
+        "trace_overhead_pct": round(100.0 * (off_med - med) / off_med, 2)
+                              if off_med > 0 else 0.0,
+        "trace_spread": max(_spread(samples, med),
+                            _spread(off_samples, off_med)),
+        "trace_dropped_at_default": int(dropped_at_default),
     }
+    if wall_sk is not None:
+        # recorder-side enqueue→complete wall percentiles (the traced
+        # identity's wall, vs the bench's submit→callback lats above)
+        out["trace_wall_p99_us"] = round(wall_sk.quantile(0.99), 1)
+    if trace_dump_path:
+        out["trace_dump"] = trace_dump_path
     snap = telemetry.snapshot()
     if "roofline" in snap:
         out["roofline"] = snap["roofline"]
@@ -951,6 +1047,11 @@ def main() -> int:
     parser.add_argument("--predict-linger-us", type=int, default=500,
                         help="ServingFront max coalescing linger for "
                              "--bench-serve (the predict_linger_us knob)")
+    parser.add_argument("--trace-dump", default="",
+                        help="flight-recorder dump dir for --bench-serve "
+                             "(the swap-phase ring flushes there as JSONL "
+                             "on close; render/validate with "
+                             "scripts/trace_report.py)")
     args = parser.parse_args()
     if args.bench_ingest:
         return bench_ingest(args)

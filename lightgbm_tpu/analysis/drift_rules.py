@@ -224,6 +224,10 @@ D2_INFORMATIONAL = {
     "ckpt_dropped":
         "latest-wins snapshot replacement is the async writer's "
         "DESIGNED backpressure, not a loss",
+    "trace_wall_p99_us":
+        "the flight-recorder ring's exact per-event p99, a cross-check "
+        "of the sketch-computed serve_p99_us LATENCY lane (agreement is "
+        "asserted in-bench within bucket resolution)",
 }
 
 # name shapes that mark a bench emission gateable, and the perf_gate

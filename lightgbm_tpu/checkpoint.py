@@ -54,7 +54,7 @@ from typing import List, Optional
 
 import numpy as np
 
-from . import lifecycle, telemetry
+from . import lifecycle, telemetry, tracing
 from .utils import log
 
 MAGIC = "lightgbm_tpu_checkpoint"
@@ -247,6 +247,9 @@ def write_checkpoint(directory: str, payload: dict,
         os.fsync(f.fileno())
     os.replace(tmp, final)
     telemetry.count("ckpt/written")
+    if tracing.active():
+        tracing.event("ckpt_write", iter=int(payload["iteration"]),
+                      bytes=len(body))
     if keep >= 1:
         for old in list_checkpoints(directory)[:-keep]:
             try:
@@ -395,6 +398,8 @@ class CheckpointWriter:
             if self._pending is not None:
                 self.dropped += 1
                 telemetry.count("ckpt/dropped")
+                if tracing.active():
+                    tracing.event("ckpt_drop")
             self._pending = raw_state
             telemetry.count("ckpt/snapshots")
             self._cv.notify()

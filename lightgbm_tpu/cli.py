@@ -165,6 +165,9 @@ KNOB_INVENTORY = {
     "memory_stats": "auto/true/false device-memory gauges",
     "timeline": "auto/true/false per-process JSONL shards",
     "stall_timeout": "hung-collective flight-recorder timeout (seconds)",
+    "trace_ring_events": "flight-recorder event-ring slots (drops oldest)",
+    "trace_dump_dir": "flight-recorder JSONL dump dir (close + fault)",
+    "trace_sketch_growth": "latency-sketch log-bucket growth factor",
     # serving
     "predict_buckets": "compiled batch-shape ladder (comma ints)",
     "predict_quantize": "float32 or int8 leaf-value serving tables",
@@ -234,7 +237,7 @@ KNOB_INVENTORY = {
 }
 
 from . import config as config_mod
-from . import telemetry
+from . import telemetry, tracing
 from .config import OverallConfig
 from .io.dataset import Dataset
 from .metrics import create_metric
@@ -264,10 +267,16 @@ class Application:
                              # "true" arms shard mode immediately
                              timeline=(io.timeline == "true"))
             telemetry.reset()
+            # flight recorder (ISSUE 16): always-on under the telemetry
+            # session — bounded by the preallocated ring, disarmed (and
+            # dumped, when trace_dump_dir is set) by telemetry.disable()
+            tracing.arm(ring_events=io.trace_ring_events,
+                        dump_dir=io.trace_dump_dir or None,
+                        sketch_growth=io.trace_sketch_growth)
             log.debug("telemetry armed: metrics_out=%s fence=%s memory=%s "
-                      "timeline=%s"
+                      "timeline=%s trace_ring=%d"
                       % (io.metrics_out, io.metrics_fence, mem_on,
-                         io.timeline))
+                         io.timeline, io.trace_ring_events))
         if io.stall_timeout > 0:
             # hung-collective flight recorder (ISSUE 5): gbdt.run_training
             # arms the watchdog thread around the training loop

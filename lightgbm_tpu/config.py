@@ -17,6 +17,7 @@ than socket/MPI ranks (see lightgbm_tpu/parallel/).
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Dict, List, Optional
 
 from .utils import log
@@ -165,6 +166,21 @@ class IOConfig:
     # training makes no progress for that long — before the runtime's
     # own opaque dispatch watchdog kills the job.  0 disables.
     stall_timeout: float = 0.0
+    # Flight recorder (ISSUE 16, lightgbm_tpu/tracing.py): the always-on
+    # per-event tier under telemetry — per-request serving latency
+    # attribution, training timeline events, streaming percentile
+    # sketches.  trace_ring_events bounds the preallocated event ring
+    # (drops oldest past it, counted ``trace/dropped``); matches
+    # tracing.DEFAULT_RING_EVENTS — perf_gate treats drops at THIS
+    # default as an absolute finding.
+    trace_ring_events: int = 65536
+    # trace_dump_dir: where ring dumps land as JSONL (atomic tmp+rename)
+    # on clean close AND from the fault/crash paths; "" = no dumps.
+    # Render/validate with scripts/trace_report.py.
+    trace_dump_dir: str = ""
+    # trace_sketch_growth: log-bucket growth factor of the percentile
+    # sketches — quantiles are exact to within a factor sqrt(growth)
+    trace_sketch_growth: float = 1.05
     output_result: str = "LightGBM_predict_result.txt"
     input_model: str = ""
     input_init_score: str = ""
@@ -303,6 +319,28 @@ class IOConfig:
                                         self.stall_timeout)
         log.check(self.stall_timeout >= 0.0,
                   "stall_timeout should be >= 0")
+        self.trace_ring_events = _get_int(params, "trace_ring_events",
+                                          self.trace_ring_events)
+        log.check(self.trace_ring_events > 0,
+                  "trace_ring_events should be > 0 (preallocated "
+                  "flight-recorder ring slots)")
+        if "trace_dump_dir" in params:
+            self.trace_dump_dir = params["trace_dump_dir"]
+            if self.trace_dump_dir:
+                # loud reject at parse time (ISSUE 16): a dump dir that
+                # cannot take writes would otherwise fail silently at
+                # the one moment it matters — inside a crash dump
+                try:
+                    os.makedirs(self.trace_dump_dir, exist_ok=True)
+                except OSError:
+                    pass
+                log.check(os.path.isdir(self.trace_dump_dir)
+                          and os.access(self.trace_dump_dir, os.W_OK),
+                          "trace_dump_dir must be a writable directory")
+        self.trace_sketch_growth = _get_float(params, "trace_sketch_growth",
+                                              self.trace_sketch_growth)
+        log.check(1.0005 <= self.trace_sketch_growth <= 2.0,
+                  "trace_sketch_growth should be in [1.0005, 2.0]")
         self.num_model_predict = _get_int(params, "num_model_predict", self.num_model_predict)
         self.predict_buckets = _get_str(params, "predict_buckets",
                                         self.predict_buckets)

@@ -322,6 +322,17 @@ class Instrumented:
                "calls": 0, "warm": warm_hint, "gen": _generation}
         rec.update(_analyze(compiled))
         _records.append(rec)
+        try:
+            from . import tracing
+            if tracing.active():
+                # compile captures on the flight-recorder timeline
+                # (ISSUE 16): a mid-run capture next to a latency spike
+                # is usually the whole explanation
+                tracing.event("compile_capture", name=self.name,
+                              phase=self.phase, seconds=dt,
+                              warm=warm_hint)
+        except Exception:
+            pass
         entry = (rec, compiled)
         self._cache[sig] = entry
         return entry

@@ -152,4 +152,10 @@ def maybe_fire(iteration: int) -> None:
         time.sleep(stall)
     else:
         log.warning("fault injection: raising at iteration %d" % iteration)
+        # flight-recorder hatch dump (ISSUE 16): flush the last-N-events
+        # ring BEFORE the raise — run_training's crash path also dumps,
+        # but a raise escaping outside run_training would otherwise
+        # leave no timeline at all
+        from . import tracing
+        tracing.dump_on_fault("injected_raise")
         raise RuntimeError("injected fault at iteration %d" % iteration)

@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import faults as faults_mod
-from .. import hatches, telemetry
+from .. import hatches, telemetry, tracing
 from ..utils import log
 from ..ops.scoring import add_tree_score
 from ..ops.lookup import exact_table_lookup as _leaf_lookup
@@ -578,6 +578,11 @@ class GBDT:
         fresh draw from the single shared RNG stream."""
         if not self._use_bagging or it % self.gbdt_config.bagging_freq != 0:
             return
+        if tracing.active():
+            # here (not _bagging) so the chunked path's batched draws
+            # land on the flight-recorder timeline too — one event per
+            # actual RNG advance, replay redraws included
+            tracing.event("bagging_draw", iter=int(it))
         frac = self.gbdt_config.bagging_fraction
         if self._bag_device:
             # device draw (ISSUE 8, ops/sampling.py): the redraw is a key
@@ -653,6 +658,8 @@ class GBDT:
                 self._goss_amp)
             sp.fence(mask)
         telemetry.count("goss/iterations")
+        if tracing.active():
+            tracing.event("goss_draw", iter=int(self.iter))
         return g, h, mask
 
     def _feature_sample(self, cls: int) -> np.ndarray:
@@ -1118,6 +1125,8 @@ class GBDT:
         if self._straggler_monitor is not None:
             self._straggler_monitor.reset()
         telemetry.count("elastic/shrinks")
+        if tracing.active():
+            tracing.event("elastic_shrink", iter=int(self.iter))
         return False
 
     def train_one_iter(self, is_eval: bool = True) -> bool:
@@ -1671,6 +1680,10 @@ class GBDT:
                     telemetry.emit_summary(extra=extra)
                 except Exception:
                     pass
+            # flight-recorder crash dump (ISSUE 16): the ring's last-N
+            # events land beside the checkpoint — best-effort, after the
+            # summary, never masking the real fault
+            tracing.dump_on_fault(type(e).__name__)
             raise
         finally:
             self._pipeline_auto = False
@@ -1941,6 +1954,10 @@ class GBDT:
         prev_rec = self._pipe_chunk
         base_iter = self.iter + (prev_rec["planned"]
                                  if prev_rec is not None else 0)
+        if tracing.active():
+            # chunk boundary on the flight-recorder timeline (ISSUE 16)
+            tracing.event("train_chunk", base_iter=int(base_iter),
+                          k=int(k))
         # in-chunk GOSS key stream: global iteration numbers ride the
         # scan xs (fold_in(PRNGKey(seed), iteration) in-program — the
         # rollback machinery needs NO snapshot, the draw is a pure

@@ -74,7 +74,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from . import costmodel, lifecycle, telemetry
+from . import costmodel, lifecycle, telemetry, tracing
 
 DEFAULT_BUCKETS: Tuple[int, ...] = (1, 32, 1024, 65536)
 
@@ -500,6 +500,11 @@ class ServingEngine:
         outs = []
         telemetry.count("serve/predict_calls")
         telemetry.count("serve/rows", N)
+        # per-request attribution (ISSUE 16): when a ServingFront batch
+        # is being scored on this thread, fill in its dispatch/walk
+        # boundary marks + pad/bucket detail; direct engine calls see
+        # None and pay nothing
+        bt = tracing.current_batch()
         with telemetry.span("predict") as sp:
             for s in range(0, max(N, 1), maxb):
                 chunk = codes[:, s:s + maxb]
@@ -507,14 +512,24 @@ class ServingEngine:
                 b = self.bucket_for(n)
                 if b > n:
                     telemetry.count("serve/pad_rows", b - n)
+                    if bt is not None:
+                        bt.add_pad(b - n)
                     chunk = np.concatenate(
                         [chunk, np.zeros((chunk.shape[0], b - n),
                                          chunk.dtype)], axis=1)
                 telemetry.count(f"serve/bucket_{b}")
+                if bt is not None:
+                    bt.set_bucket(b)
+                    bt.mark_run_begin()
+                out = run(chunk)
+                if bt is not None:
+                    bt.mark_dispatched()
                 # fence like every device-work span (PR 4): unfenced
                 # async spans time the dispatch, not the walk, and the
                 # predict-phase roofline would be meaningless
-                outs.append((sp.fence(run(chunk)), n))
+                outs.append((sp.fence(out), n))
+                if bt is not None:
+                    bt.mark_run_end()
         return assemble(outs)
 
     def scores(self, features: np.ndarray) -> np.ndarray:
@@ -562,13 +577,21 @@ class ServingEngine:
 
 
 class _FrontRequest:
-    __slots__ = ("features", "future", "rows", "t_submit")
+    __slots__ = ("features", "future", "rows", "t_submit", "trace_id",
+                 "t_enq_ns", "block_ns")
 
-    def __init__(self, features, future, rows, t_submit):
+    def __init__(self, features, future, rows, t_submit, trace_id=0,
+                 t_enq_ns=0, block_ns=0):
         self.features = features
         self.future = future
         self.rows = rows
         self.t_submit = t_submit
+        # flight-recorder identity + integer enqueue stamp (ISSUE 16):
+        # the attribution identity needs perf_counter_ns boundaries —
+        # float-second chains do not telescope exactly
+        self.trace_id = trace_id
+        self.t_enq_ns = t_enq_ns
+        self.block_ns = block_ns
 
 
 class _SwapMarker:
@@ -651,21 +674,44 @@ class ServingFront:
             raise ValueError("submit expects a [rows, features] matrix")
         n = features.shape[0]
         fut: Future = Future()
+        t_arrive_ns = time.perf_counter_ns()
         with self._cond:
             if self._closed:
                 raise RuntimeError("ServingFront is closed")
+            blocked = False
             while self._queued_rows > 0 \
                     and self._queued_rows + n > self.queue_rows:
+                blocked = True
                 self._cond.wait(0.05)
                 if self._closed:
                     raise RuntimeError("ServingFront is closed")
-            self._queue.append(_FrontRequest(features, fut, n,
-                                             time.perf_counter()))
+            # enqueue stamp AFTER any backpressure block: the traced
+            # wall time is enqueue → complete; the block rides the
+            # timeline as its own event, not inside the identity
+            t_enq_ns = time.perf_counter_ns()
+            req = _FrontRequest(features, fut, n, time.perf_counter(),
+                                trace_id=tracing.next_trace_id(),
+                                t_enq_ns=t_enq_ns,
+                                block_ns=(t_enq_ns - t_arrive_ns
+                                          if blocked else 0))
+            self._queue.append(req)
             self._queued_rows += n
             self.stats["requests"] += 1
             self.stats["rows"] += n
             if self._queued_rows > self.stats["queue_peak_rows"]:
                 self.stats["queue_peak_rows"] = self._queued_rows
+            # the enqueue event files BEFORE the front lock releases:
+            # the worker cannot dequeue (it needs this lock) until the
+            # event is in the ring, so ring order always shows a
+            # request's enqueue before its completion — the ordering
+            # contract trace_report --check validates.  tracing._lock is
+            # a leaf lock; tracing never calls back into the front.
+            if tracing.active():
+                tracing.event("serve_enqueue", trace=req.trace_id, rows=n,
+                              t_ns=t_enq_ns)
+                if blocked:
+                    tracing.event("serve_backpressure", trace=req.trace_id,
+                                  block_ns=req.block_ns)
             self._cond.notify_all()
         telemetry.count("serve/front_requests")
         telemetry.count("serve/front_rows", n)
@@ -695,6 +741,7 @@ class ServingFront:
                 raise RuntimeError("ServingFront is closed")
             self._queue.append(marker)
             self._cond.notify_all()
+        tracing.event("serve_swap_enqueue")
         if not marker.event.wait(timeout):
             # a timed-out swap must not flip LATER behind the caller's
             # back: withdraw the marker if the worker has not reached it
@@ -772,7 +819,13 @@ class ServingFront:
                     self._queue.popleft()
                     self._engine = head.engine
                     head.event.set()
+                    tracing.event("serve_swap_flip",
+                                  drain_us=int((time.perf_counter()
+                                                - head.t0) * 1e6))
                     continue
+                # first batch boundary (ISSUE 16): the worker has seen
+                # the head — queue-wait ends here, linger-wait begins
+                t_linger_ns = time.perf_counter_ns()
                 maxb = self._engine.buckets[-1]
                 deadline = head.t_submit + self.linger_s
                 while not self._closed:
@@ -792,6 +845,7 @@ class ServingFront:
                     self._queue.popleft()
                     batch.append(r)
                     total += r.rows
+                t_form_ns = time.perf_counter_ns()
                 self._queued_rows -= total
                 depth_after = self._queued_rows
                 engine = self._engine
@@ -810,9 +864,17 @@ class ServingFront:
             telemetry.count("serve/queue_depth_samples")
             feats = (batch[0].features if len(batch) == 1 else
                      np.concatenate([r.features for r in batch], axis=0))
+            # batch trace (ISSUE 16): installed thread-locally so
+            # engine._bucketed fills in the dispatch/walk marks +
+            # pad/bucket detail while scoring on THIS thread
+            bt = tracing.begin_batch() if tracing.active() else None
             try:
                 scores = engine.scores(feats)
             except BaseException as e:  # surfaced per request, never lost
+                tracing.end_batch()
+                if bt is not None:
+                    tracing.event("serve_error", batch=bt.batch_id,
+                                  rows=total, error=type(e).__name__)
                 for r in batch:
                     # same check→set race as delivery below: a client
                     # cancelling between the check and the set raises
@@ -825,6 +887,15 @@ class ServingFront:
                     except Exception:
                         pass
                 continue
+            tracing.end_batch()
+            t_scores_ns = time.perf_counter_ns()
+            if bt is not None:
+                tracing.event("serve_batch", batch=bt.batch_id,
+                              requests=len(batch), rows=total,
+                              bucket=bt.bucket, pad_rows=bt.pad_rows,
+                              wait_us=int(wait_s * 1e6))
+                bounds = (t_linger_ns, t_form_ns, bt.run_begin_ns,
+                          bt.dispatched_ns, t_scores_ns)
             ofs = 0
             for r in batch:
                 # per-request delivery: one client cancelling its Future
@@ -836,6 +907,14 @@ class ServingFront:
                 except Exception:
                     pass
                 ofs += r.rows
+                if bt is not None:
+                    # complete stamp per request, AFTER its delivery —
+                    # the six components telescope exactly to
+                    # t_done - t_enq (the test-pinned identity)
+                    tracing.record_serve_request(
+                        r.trace_id, bt, r.t_enq_ns,
+                        time.perf_counter_ns(), bounds, r.rows,
+                        block_ns=r.block_ns)
 
 
 def engine_options_from_config(io_config) -> dict:

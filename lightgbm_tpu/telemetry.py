@@ -164,6 +164,27 @@ ISSUE 14 — preemption-safe elastic training (checkpoint.py, elastic.py):
     collective sites — both censused by graftlint J2
     (analysis/programs.elastic_programs).  ``elastic/shrinks`` counts
     executed drain-at-boundary mesh shrinks.
+
+ISSUE 16 — flight recorder + per-request latency attribution
+(lightgbm_tpu/tracing.py rides this registry's lifecycle):
+
+12. **The ``trace/*`` family contract**: the flight recorder mirrors
+    exactly two counters into this registry — ``trace/dropped`` (ring
+    events overwritten before being read; ANY nonzero at the default
+    ``trace_ring_events`` is an absolute perf_gate finding) and
+    ``trace/dumps`` (JSONL dump files written: clean close, watchdog
+    and fault/crash paths alike).  The dump writer runs under the
+    ``trace_dump`` span.  Everything else the recorder knows —
+    per-request component attribution (queue/linger/coalesce/dispatch/
+    walk/scatter, summing EXACTLY to each request's wall time), the
+    event ring, and the fixed-memory log-bucket percentile sketches per
+    latency family (``serve_wall_us``, ``serve_<component>_us``,
+    ``train_iter_us``) — stays in tracing.py and reaches records as the
+    summary's ``trace`` block (``tracing.snapshot()``) and the
+    ``trace_dump_dir=`` JSONL dumps (``scripts/trace_report.py``).
+    ``disable()`` disarms the recorder (dumping first when configured);
+    ``emit_iteration`` files one ``train_iter`` ring event per
+    iteration sharing the timeline-shard record keys.
 """
 from __future__ import annotations
 
@@ -263,6 +284,8 @@ COUNTER_FAMILIES = (
     "serve/swap_drain_us",
     "serve/swaps",
     "serve/warmups",
+    "trace/dropped",
+    "trace/dumps",
 )
 
 SPAN_FAMILIES = (
@@ -285,6 +308,7 @@ SPAN_FAMILIES = (
     "predict_warmup",
     "score_update",
     "split_find",
+    "trace_dump",
     "train_chunk",
     "valid_update",
 )
@@ -438,11 +462,18 @@ def enable(jsonl_path: Optional[str] = None, fence: bool = False,
 
 def disable() -> None:
     """Stop recording and close the sink (pending data is flushed).
-    Also disarms the stall watchdog and leaves timeline mode — the
-    registry returns to its process-global resting state."""
+    Also disarms the stall watchdog, leaves timeline mode and disarms
+    the flight recorder (tracing.py — which dumps its ring first when a
+    dump dir is configured) — the registry returns to its process-global
+    resting state."""
     global _enabled, _fence, _sink_file, _sink_path, _memory
     global _timeline, _shard_path_used, _wd_timeout_cfg
     disarm_watchdog()
+    try:
+        from . import tracing
+        tracing.disarm()
+    except Exception:
+        pass
     _timeline = False
     _shard_path_used = None
     _wd_timeout_cfg = 0.0
@@ -1474,6 +1505,16 @@ def emit_iteration(iteration: int, phase_times: Dict[str, float],
         record["t"] = round(time.time(), 6)
     if _ring_armed:
         _ring_event("iteration", str(iteration))
+    try:
+        from . import tracing
+        if tracing.active():
+            # the flight recorder's training timeline (ISSUE 16): one
+            # train_iter ring event per iteration, same record keys as
+            # the timeline shards (iter / phase_times / t)
+            tracing.record_train_iteration(iteration,
+                                           record["phase_times"])
+    except Exception:
+        pass
     watchdog_checkin(iteration=iteration)
     if trace_times:
         record["trace_times"] = _round_times(trace_times)
@@ -1507,6 +1548,16 @@ def emit_summary(extra: Optional[dict] = None) -> dict:
     if ic is not None:
         record["interconnect"] = ic
     _attach_cost_blocks(record)
+    try:
+        from . import tracing
+        trace = tracing.snapshot()
+        if trace:
+            # flight-recorder close-out (ISSUE 16): ring occupancy,
+            # exact drop count and the live sketch percentiles ride the
+            # summary record — percentiles at close without a bench run
+            record["trace"] = trace
+    except Exception:
+        pass
     if extra:
         record.update(extra)
     write_record(record)

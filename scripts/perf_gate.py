@@ -128,6 +128,12 @@ LATENCY_KEYS: Tuple[Tuple[str, str], ...] = (
     # better; gated must-not-grow at the wide observability floor (the
     # overhead is a small difference of two noisy wall times).
     ("ckpt_overhead_pct", "ckpt_spread"),
+    # flight-recorder cost (ISSUE 16, bench.py --bench-serve): percent
+    # serve throughput lost with the recorder armed, from interleaved
+    # recorder-on/off segments of the same open-loop load.  "Always-on"
+    # is only honest while this stays flat — gated must-not-grow at the
+    # wide observability floor (a small difference of two noisy rates).
+    ("trace_overhead_pct", "trace_spread"),
 )
 
 # absolute zero-tolerance keys (no trajectory needed): any nonzero on
@@ -147,6 +153,10 @@ ABSOLUTE_ZERO_KEYS: Tuple[Tuple[str, str], ...] = (
     ("serve_misscored",
      "request(s) misscored across the mid-load hot swap (a result "
      "matched neither the old nor the new engine — a torn swap)"),
+    ("trace_dropped_at_default",
+     "flight-recorder ring overflowed at the DEFAULT trace_ring_events "
+     "during a measured serve window (ISSUE 16) — the last-N-events "
+     "crash timeline no longer covers a single load segment"),
 )
 
 # absolute must-be-true keys (ISSUE 14): a recorded value of exactly

@@ -22,6 +22,10 @@ trailing {"summary": true, ...} record) and prints:
     fraction-of-peak when the device kind is known) and the compile
     table (program inventory, compile seconds, cache hits, mid-run
     recompiles),
+  - the flight-recorder table (ISSUE 16 ``trace`` block: ring
+    occupancy/drop/dump counts, streaming-sketch latency percentiles per
+    family, and the per-component serve attribution — mean share and p99
+    share of the request wall time),
   - first/last eval metric values per dataset/metric.
 
 Malformed or truncated JSONL exits with a one-line error (code 2), not a
@@ -265,6 +269,63 @@ def _serve_lines(counters):
     return out
 
 
+def _trace_lines(trace):
+    """The flight-recorder block (ISSUE 16, ``trace`` summary key from
+    tracing.snapshot()): ring occupancy + exact drop count, per-family
+    streaming-sketch percentiles, and the per-component serve-latency
+    attribution table.  Component means/p99s come from the same
+    fixed-memory log-bucket sketches, so shares are exact to within the
+    sketch's bucket resolution."""
+    out = ["Flight recorder (trace)", "-----------------------"]
+    if not trace:
+        out.append("(no trace block — the recorder arms with any "
+                   "metrics_out= session; see lightgbm_tpu/tracing.py)")
+        return out
+    out.append("ring %d/%d events  (appended %d, dropped %d, dumps %d, "
+               "sketch growth %g%s)"
+               % (trace.get("events", 0), trace.get("ring_events", 0),
+                  trace.get("appended", 0), trace.get("dropped", 0),
+                  trace.get("dumps", 0), trace.get("sketch_growth", 0.0),
+                  ", default ring" if trace.get("default_ring") else ""))
+    sketches = trace.get("sketches") or {}
+    if not sketches:
+        out.append("(no sketch observations)")
+        return out
+
+    def _us(x):
+        return ("%10.1f" % x) if isinstance(x, (int, float)) else "%10s" % "-"
+
+    width = max(len(k) for k in sketches)
+    out.append(f"{'family'.ljust(width)}  {'count':>8}  {'mean us':>10}  "
+               f"{'p50 us':>10}  {'p99 us':>10}  {'p999 us':>10}")
+    for fam, pc in sorted(sketches.items()):
+        out.append(f"{fam.ljust(width)}  {pc.get('count', 0):>8}  "
+                   + "  ".join(_us(pc.get(k))
+                               for k in ("mean", "p50", "p99", "p999")))
+    # per-component serve attribution: where a request's wall time went
+    # (component order mirrors tracing.COMPONENTS — the timeline order)
+    wall = sketches.get("serve_wall_us") or {}
+    comps = [(c, sketches.get("serve_%s_us" % c))
+             for c in ("queue", "linger", "coalesce", "dispatch", "walk",
+                       "scatter")]
+    comps = [(c, pc) for c, pc in comps if pc]
+    if wall and comps:
+        mean_total = sum(pc.get("mean") or 0.0 for _c, pc in comps)
+        wall_p99 = wall.get("p99") or 0.0
+        out.append("serve attribution (per component of the exact "
+                   "wall-time identity):")
+        for c, pc in comps:
+            mean = pc.get("mean") or 0.0
+            p99 = pc.get("p99") or 0.0
+            out.append("  %-9s mean %9.1f us (%5.1f%%)   p99 %9.1f us "
+                       "(%5.1f%% of wall p99)"
+                       % (c, mean,
+                          100.0 * mean / mean_total if mean_total else 0.0,
+                          p99,
+                          100.0 * p99 / wall_p99 if wall_p99 else 0.0))
+    return out
+
+
 def _compile_lines(comp):
     out = ["Compile observability", "---------------------"]
     if not comp:
@@ -329,6 +390,7 @@ def report(path: str, as_json: bool = False) -> int:
     roofline = (summary or {}).get("roofline")
     comp = (summary or {}).get("compile")
     interconnect = (summary or {}).get("interconnect")
+    trace = (summary or {}).get("trace")
 
     if as_json:
         print(json.dumps({
@@ -344,6 +406,7 @@ def report(path: str, as_json: bool = False) -> int:
             "roofline": roofline or {},
             "compile": comp or {},
             "interconnect": interconnect or {},
+            "trace": trace or {},
             "eval_first_last": {k: [v[0], v[-1]]
                                 for k, v in sorted(evals.items())},
         }))
@@ -409,6 +472,8 @@ def report(path: str, as_json: bool = False) -> int:
     out += _roofline_lines(roofline)
     out.append("")
     out += _interconnect_lines(interconnect)
+    out.append("")
+    out += _trace_lines(trace)
     out.append("")
     out += _compile_lines(comp)
     if evals:

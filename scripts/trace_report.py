@@ -1,0 +1,314 @@
+"""Render / validate flight-recorder dumps (ISSUE 16).
+
+Usage:
+    python scripts/trace_report.py trace-1234-001.jsonl
+    python scripts/trace_report.py --json  trace-1234-001.jsonl
+    python scripts/trace_report.py --check trace-1234-001.jsonl [more...]
+
+A dump (lightgbm_tpu/tracing.py, written atomically on clean close and
+from the fault/crash paths) is one ``trace_header`` JSON line — reason,
+ring occupancy, exact drop count, serialized latency sketches — followed
+by the retained ring events oldest-first.  The default mode prints the
+event-kind histogram, the per-component serve-latency attribution table
+(mean / p99 / max, computed exactly from the raw ``serve_complete``
+events) and the header sketches' streaming percentiles.
+
+``--check`` validates the recorder's hard contracts and exits 1 on any
+violation (2 on unreadable input), printing one line per finding:
+
+  - unparseable JSONL, or a first line that is not a ``trace_header``;
+  - the attribution identity: ``sum(components_ns) != wall_ns`` on ANY
+    ``serve_complete`` event — the components must telescope exactly;
+  - a negative component or negative wall;
+  - event ordering: a request's ``serve_enqueue`` appearing after its
+    ``serve_complete`` in ring order, or a completion with no enqueue in
+    a dump whose header says nothing was dropped (dropped enqueues are
+    tolerated — the ring drops oldest-first by design);
+  - header bookkeeping: ``events`` not matching the event lines actually
+    present, or ``dropped != max(0, appended - events)``.
+
+Standalone stdlib script — it parses dumps by schema (the component
+names mirror tracing.COMPONENTS) so it runs anywhere, including on dumps
+scp'd off a crashed host.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+
+# mirrors lightgbm_tpu.tracing.COMPONENTS (timeline order) — kept inline
+# so the script stays dependency-free on crash-forensics hosts
+COMPONENTS = ("queue", "linger", "coalesce", "dispatch", "walk", "scatter")
+
+
+class BadDump(Exception):
+    pass
+
+
+def load(path: str):
+    """-> (header dict, [event dicts]).  Raises BadDump on junk."""
+    try:
+        f = open(path)
+    except OSError as e:
+        raise BadDump("cannot read %s: %s" % (path, e))
+    header, events = None, []
+    with f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError as e:
+                raise BadDump("%s:%d: unparseable JSONL (%s)"
+                              % (path, lineno, e))
+            if lineno == 1:
+                if not isinstance(rec, dict) or "trace_header" not in rec:
+                    raise BadDump("%s:1: first line is not a trace_header"
+                                  % path)
+                header = rec["trace_header"]
+            elif not isinstance(rec, dict) or "kind" not in rec:
+                raise BadDump("%s:%d: event line without a kind"
+                              % (path, lineno))
+            else:
+                events.append(rec)
+    if header is None:
+        raise BadDump("%s: empty dump (no trace_header line)" % path)
+    return header, events
+
+
+def check(path: str, header: dict, events: list) -> list:
+    """All contract violations in one dump (empty list = clean)."""
+    bad = []
+    if header.get("events") != len(events):
+        bad.append("%s: header says %s events but %d lines present"
+                   % (path, header.get("events"), len(events)))
+    appended = int(header.get("appended", len(events)))
+    want_drop = max(0, appended - len(events))
+    if int(header.get("dropped", 0)) != want_drop:
+        bad.append("%s: header dropped=%s but appended=%d with %d retained "
+                   "events implies %d"
+                   % (path, header.get("dropped"), appended, len(events),
+                      want_drop))
+    dropped = int(header.get("dropped", 0))
+    enq_pos = {}
+    for pos, ev in enumerate(events):
+        if ev.get("kind") == "serve_enqueue" and "trace" in ev:
+            enq_pos.setdefault(ev["trace"], pos)
+    for pos, ev in enumerate(events):
+        if ev.get("kind") != "serve_complete":
+            continue
+        tid = ev.get("trace")
+        comps = ev.get("components_ns")
+        wall = ev.get("wall_ns")
+        if not isinstance(comps, dict) or not isinstance(wall, int):
+            bad.append("%s: trace %s serve_complete missing "
+                       "components_ns/wall_ns" % (path, tid))
+            continue
+        missing = [c for c in COMPONENTS if c not in comps]
+        if missing:
+            bad.append("%s: trace %s missing component(s) %s"
+                       % (path, tid, ",".join(missing)))
+            continue
+        if wall < 0:
+            bad.append("%s: trace %s negative wall_ns %d"
+                       % (path, tid, wall))
+        neg = [c for c in COMPONENTS if comps[c] < 0]
+        if neg:
+            bad.append("%s: trace %s negative component(s) %s"
+                       % (path, tid, ",".join(neg)))
+        total = sum(comps[c] for c in COMPONENTS)
+        if total != wall:
+            bad.append("%s: trace %s attribution identity broken: "
+                       "sum(components)=%d != wall=%d"
+                       % (path, tid, total, wall))
+        pos_enq = enq_pos.get(tid)
+        if pos_enq is None:
+            if dropped == 0:
+                bad.append("%s: trace %s completed with no enqueue event "
+                           "in a dump with dropped=0" % (path, tid))
+        elif pos_enq > pos:
+            bad.append("%s: trace %s enqueue at line %d AFTER its "
+                       "completion at line %d"
+                       % (path, tid, pos_enq + 2, pos + 2))
+    return bad
+
+
+def _nearest_rank(sorted_vals, q):
+    n = len(sorted_vals)
+    return sorted_vals[min(n - 1, max(0, int(math.ceil(q * n)) - 1))]
+
+
+def _sketch_quantile(sk: dict, q: float):
+    """Nearest-rank quantile of one serialized sketch (growth/zero/
+    buckets) — mirrors tracing.LatencySketch.quantile."""
+    zero = int(sk.get("zero", 0))
+    buckets = {int(i): int(c) for i, c in (sk.get("buckets") or {}).items()}
+    total = zero + sum(buckets.values())
+    if total == 0:
+        return None
+    rank = min(total - 1, max(0, int(math.ceil(q * total)) - 1))
+    if rank < zero:
+        return 0.0
+    g = float(sk.get("growth", 1.05))
+    seen = zero
+    for i in sorted(buckets):
+        seen += buckets[i]
+        if rank < seen:
+            return g ** (i + 0.5)
+    return None
+
+
+def summarize(header: dict, events: list) -> dict:
+    kinds = {}
+    for ev in events:
+        kinds[ev.get("kind", "?")] = kinds.get(ev.get("kind", "?"), 0) + 1
+    comps = {c: [] for c in COMPONENTS}
+    walls = []
+    for ev in events:
+        if ev.get("kind") != "serve_complete":
+            continue
+        cn = ev.get("components_ns") or {}
+        if all(c in cn for c in COMPONENTS):
+            for c in COMPONENTS:
+                comps[c].append(cn[c])
+            walls.append(ev.get("wall_ns", 0))
+    attribution = {}
+    for c in COMPONENTS:
+        vals = sorted(comps[c])
+        if not vals:
+            continue
+        attribution[c] = {
+            "count": len(vals),
+            "mean_us": round(sum(vals) / len(vals) / 1e3, 1),
+            "p99_us": round(_nearest_rank(vals, 0.99) / 1e3, 1),
+            "max_us": round(vals[-1] / 1e3, 1),
+        }
+    walls.sort()
+    out = {
+        "reason": header.get("reason"),
+        "pid": header.get("pid"),
+        "ring_events": header.get("ring_events"),
+        "events": len(events),
+        "appended": header.get("appended"),
+        "dropped": header.get("dropped"),
+        "kinds": dict(sorted(kinds.items())),
+        "attribution": attribution,
+    }
+    if walls:
+        out["wall_us"] = {
+            "count": len(walls),
+            "mean_us": round(sum(walls) / len(walls) / 1e3, 1),
+            "p99_us": round(_nearest_rank(walls, 0.99) / 1e3, 1),
+            "max_us": round(walls[-1] / 1e3, 1),
+        }
+    sketches = {}
+    for fam, sk in sorted((header.get("sketches") or {}).items()):
+        zero = int(sk.get("zero", 0))
+        cnt = zero + sum(int(c) for c in (sk.get("buckets") or {}).values())
+        sketches[fam] = {
+            "count": cnt,
+            "p50": _sketch_quantile(sk, 0.50),
+            "p99": _sketch_quantile(sk, 0.99),
+            "p999": _sketch_quantile(sk, 0.999),
+        }
+    out["sketches"] = sketches
+    return out
+
+
+def render(path: str, s: dict) -> str:
+    lines = ["trace report: %s" % path,
+             "reason=%s pid=%s  ring %s/%s events (appended %s, "
+             "dropped %s)"
+             % (s.get("reason"), s.get("pid"), s.get("events"),
+                s.get("ring_events"), s.get("appended"), s.get("dropped")),
+             "", "Event kinds", "-----------"]
+    kinds = s.get("kinds") or {}
+    if kinds:
+        width = max(len(k) for k in kinds)
+        for k, v in sorted(kinds.items()):
+            lines.append("%s  %d" % (k.ljust(width), v))
+    else:
+        lines.append("(no events)")
+    lines += ["", "Serve attribution (exact, from serve_complete events)",
+              "-----------------------------------------------------"]
+    attribution = s.get("attribution") or {}
+    if attribution:
+        lines.append("%-9s  %8s  %10s  %10s  %10s"
+                     % ("component", "count", "mean us", "p99 us", "max us"))
+        for c in COMPONENTS:
+            a = attribution.get(c)
+            if a is None:
+                continue
+            lines.append("%-9s  %8d  %10.1f  %10.1f  %10.1f"
+                         % (c, a["count"], a["mean_us"], a["p99_us"],
+                            a["max_us"]))
+        w = s.get("wall_us")
+        if w:
+            lines.append("%-9s  %8d  %10.1f  %10.1f  %10.1f"
+                         % ("wall", w["count"], w["mean_us"], w["p99_us"],
+                            w["max_us"]))
+    else:
+        lines.append("(no serve_complete events in the retained window)")
+    lines += ["", "Streaming sketches (live percentiles at dump time)",
+              "--------------------------------------------------"]
+    sketches = s.get("sketches") or {}
+    if sketches:
+        width = max(len(k) for k in sketches)
+
+        def _f(x):
+            return ("%10.1f" % x) if isinstance(x, (int, float)) \
+                else "%10s" % "-"
+
+        lines.append("%s  %8s  %10s  %10s  %10s"
+                     % ("family".ljust(width), "count", "p50", "p99",
+                        "p999"))
+        for fam, pc in sorted(sketches.items()):
+            lines.append("%s  %8d  %s  %s  %s"
+                         % (fam.ljust(width), pc["count"], _f(pc["p50"]),
+                            _f(pc["p99"]), _f(pc["p999"])))
+    else:
+        lines.append("(no sketches in header)")
+    return "\n".join(lines)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("paths", nargs="+", help="trace dump JSONL file(s)")
+    p.add_argument("--check", action="store_true",
+                   help="validate contracts; exit 1 on any violation")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable summary instead of tables")
+    args = p.parse_args()
+    findings = []
+    rc = 0
+    for path in args.paths:
+        try:
+            header, events = load(path)
+        except BadDump as e:
+            if args.check:
+                findings.append(str(e))
+                continue
+            print("trace_report error: %s" % e, file=sys.stderr)
+            return 2
+        if args.check:
+            findings.extend(check(path, header, events))
+            continue
+        s = summarize(header, events)
+        if args.json:
+            print(json.dumps({"path": path, **s}))
+        else:
+            print(render(path, s))
+    if args.check:
+        for f in findings:
+            print("TRACE-CHECK FAIL %s" % f)
+        if findings:
+            return 1
+        print("trace-check ok: %d dump(s) clean" % len(args.paths))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -66,6 +66,13 @@ def _telemetry_leak_guard():
     # registers its armed-hatch probe; without this a test that set
     # LGBM_TPU_FAULT_AT without ever importing faults would slip past
     # the guard and SIGKILL a LATER test's training loop
+    from lightgbm_tpu import tracing as _tracing  # noqa: F401 — same
+    # deal for the flight recorder (ISSUE 16): importing registers the
+    # trace-recorder probe, so a test that leaves the recorder armed —
+    # a later test's serving/training events silently filing into a
+    # foreign ring and foreign percentile sketches — fails here and is
+    # disarmed by the probe's closer (which also flushes any configured
+    # dump dir)
     from lightgbm_tpu import lifecycle as _lifecycle
     leaked_objects = _lifecycle.leaks()
     for _kind, _name, _closer in leaked_objects:

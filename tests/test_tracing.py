@@ -1,0 +1,462 @@
+"""Flight recorder + per-request latency attribution (ISSUE 16,
+lightgbm_tpu/tracing.py + scripts/trace_report.py).
+
+Correctness bars, in the ISSUE's order:
+
+(a) the attribution identity: every traced request's six components
+    (queue/linger/coalesce/dispatch/walk/scatter) sum EXACTLY to its
+    observed wall time — per request, including across a mid-load
+    ``swap_engine`` — an integer identity, not a tolerance;
+(b) ring-overflow determinism: a full ring drops OLDEST events first
+    and ``trace/dropped`` counts every overwrite exactly;
+(c) streaming sketches: merge is associative (bucket-count addition)
+    and any quantile is within a factor sqrt(growth) of the true sorted
+    sample quantile at the same nearest-rank;
+(d) dump-on-fault: an injected-raise training fault leaves a parseable
+    JSONL dump that trace_report --check validates;
+(e) lifecycle: the armed recorder is leak-guard-visible and
+    ``telemetry.disable()`` disarms it; config knobs reject junk loudly.
+"""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import faults, lifecycle, telemetry, tracing
+from lightgbm_tpu.io.dataset import Dataset
+from lightgbm_tpu.serving import ServingEngine, ServingFront
+from lightgbm_tpu.utils.log import LightGBMError
+from scripts import trace_report
+
+BASE = {"num_leaves": 15, "min_data_in_leaf": 20,
+        "min_sum_hessian_in_leaf": 1.0, "num_iterations": 8,
+        "learning_rate": 0.2}
+
+_CASE = {}
+
+
+def _case():
+    """(trained binary booster, features), cached once per session."""
+    if not _CASE:
+        rng = np.random.RandomState(3)
+        x = rng.randn(500, 6)
+        y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(np.float32)
+        ds = Dataset.from_arrays(x, y, max_bin=64)
+        _CASE["v"] = (lgb.train(dict(BASE, objective="binary"), ds), x)
+    return _CASE["v"]
+
+
+@pytest.fixture()
+def recorder():
+    """Armed recorder with telemetry enabled (counter mirror live);
+    disarmed + disabled afterwards whatever the test did."""
+    telemetry.enable(None)
+    telemetry.reset()
+    tracing.arm(ring_events=4096)
+    yield
+    tracing.disarm()
+    telemetry.disable()
+    telemetry.reset()
+
+
+# ===================================== (a) the exact attribution identity
+
+
+def test_attribute_identity_exhaustive_fuzz():
+    """sum(components) == wall EXACTLY for any boundary junk: missing
+    marks (None), boundaries before enqueue, after completion, or out of
+    order — the clamp makes the telescoping unconditional."""
+    rng = np.random.RandomState(11)
+    for _ in range(2000):
+        ts = int(rng.randint(0, 10_000))
+        td = ts + int(rng.randint(0, 10_000))
+        bounds = []
+        for _k in range(5):
+            r = rng.rand()
+            if r < 0.2:
+                bounds.append(None)
+            else:
+                bounds.append(int(rng.randint(-5000, 25_000)))
+        comps = tracing.attribute(ts, td, bounds)
+        assert set(comps) == set(tracing.COMPONENTS)
+        assert all(v >= 0 for v in comps.values())
+        assert sum(comps.values()) == td - ts
+
+
+def test_attribute_known_decomposition():
+    comps = tracing.attribute(100, 1100, (200, 300, None, 500, 900))
+    assert comps == {"queue": 100, "linger": 100, "coalesce": 0,
+                     "dispatch": 200, "walk": 400, "scatter": 200}
+
+
+def _dump_events(tmp_path, name="d.jsonl"):
+    path = str(tmp_path / name)
+    assert tracing.dump(path=path, reason="test") == path
+    header, events = trace_report.load(path)
+    return path, header, events
+
+
+def test_serve_identity_end_to_end(recorder, tmp_path):
+    """Every request through the coalescing front gets a serve_complete
+    whose components telescope exactly to its wall, with a unique
+    nonzero trace id and its enqueue event earlier in ring order."""
+    booster, x = _case()
+    front = ServingFront(ServingEngine(booster.export_flat()),
+                         linger_us=2000)
+    try:
+        futs = [front.submit(x[i * 10:(i + 1) * 10]) for i in range(20)]
+        for f in futs:
+            f.result(30)
+    finally:
+        front.close()
+    path, header, events = _dump_events(tmp_path)
+    comp = [e for e in events if e["kind"] == "serve_complete"]
+    enq = [e for e in events if e["kind"] == "serve_enqueue"]
+    assert len(comp) == 20 and len(enq) == 20
+    ids = [e["trace"] for e in comp]
+    assert len(set(ids)) == 20 and all(i > 0 for i in ids)
+    for e in comp:
+        assert sum(e["components_ns"][c]
+                   for c in tracing.COMPONENTS) == e["wall_ns"]
+        assert all(e["components_ns"][c] >= 0
+                   for c in tracing.COMPONENTS)
+    # the shipped validator agrees: zero findings on a clean dump
+    assert trace_report.check(path, header, events) == []
+    # sketches saw every request (wall + each component family)
+    snap = tracing.snapshot()
+    assert snap["sketches"]["serve_wall_us"]["count"] == 20
+    for c in tracing.COMPONENTS:
+        assert snap["sketches"]["serve_%s_us" % c]["count"] == 20
+
+
+def test_serve_identity_across_mid_load_swap(recorder, tmp_path):
+    """The identity holds for every request completed across a mid-load
+    drain-and-flip swap, and the swap events land on the timeline."""
+    booster, x = _case()
+    eng_a = ServingEngine(booster.export_flat(len(booster.models) - 2))
+    eng_b = ServingEngine(booster.export_flat())
+    front = ServingFront(eng_a, linger_us=500)
+    stop = threading.Event()
+    futs = []
+
+    def load():
+        i = 0
+        while not stop.is_set():
+            s = (i * 20) % 480
+            futs.append(front.submit(x[s:s + 20]))
+            i += 1
+            time.sleep(0.001)
+
+    t = threading.Thread(target=load)
+    try:
+        t.start()
+        time.sleep(0.1)
+        front.swap_engine(eng_b)
+        time.sleep(0.1)
+    finally:
+        stop.set()
+        t.join(30)
+        front.close()
+    path, header, events = _dump_events(tmp_path)
+    kinds = {e["kind"] for e in events}
+    assert {"serve_swap_enqueue", "serve_swap_flip",
+            "serve_complete"} <= kinds
+    comp = [e for e in events if e["kind"] == "serve_complete"]
+    assert len(comp) == len(futs) >= 20
+    for e in comp:
+        assert sum(e["components_ns"][c]
+                   for c in tracing.COMPONENTS) == e["wall_ns"]
+    assert trace_report.check(path, header, events) == []
+
+
+# ========================================= (b) ring-overflow determinism
+
+
+def test_ring_drops_oldest_and_counts_exactly(recorder, tmp_path):
+    tracing.arm(ring_events=8)
+    for i in range(21):
+        tracing.event("tick", seq=i)
+    snap = tracing.snapshot()
+    assert snap["appended"] == 21
+    assert snap["dropped"] == 13
+    assert snap["events"] == 8
+    # the counter mirror is exact, and repeated snapshots never
+    # double-count (delta sync)
+    assert telemetry.counters()["trace/dropped"] == 13
+    tracing.snapshot()
+    assert telemetry.counters()["trace/dropped"] == 13
+    # retained window is the NEWEST 8, oldest-first
+    _path, header, events = _dump_events(tmp_path)
+    assert [e["seq"] for e in events] == list(range(13, 21))
+    assert header["dropped"] == 13
+
+
+def test_ring_keeps_everything_below_capacity(recorder):
+    tracing.arm(ring_events=64)
+    for i in range(10):
+        tracing.event("tick", seq=i)
+    snap = tracing.snapshot()
+    assert (snap["appended"], snap["dropped"], snap["events"]) == (10, 0,
+                                                                   10)
+    assert telemetry.counters().get("trace/dropped", 0) == 0
+
+
+# ============================================= (c) streaming sketches
+
+
+def test_sketch_quantile_error_bound():
+    """Any reported quantile is within a factor sqrt(growth) of the
+    sorted sample's nearest-rank value — the bucket-resolution bound."""
+    rng = np.random.RandomState(5)
+    vals = np.exp(rng.randn(5000) * 1.5 + 3.0)
+    sk = tracing.LatencySketch(1.05)
+    for v in vals:
+        sk.record(float(v))
+    srt = np.sort(vals)
+    tol = 1.05 ** 0.5 * (1 + 1e-9)
+    for q in (0.01, 0.25, 0.50, 0.90, 0.99, 0.999):
+        rank = min(len(srt) - 1, max(0, int(np.ceil(q * len(srt))) - 1))
+        exact = float(srt[rank])
+        got = sk.quantile(q)
+        assert 1 / tol <= got / exact <= tol, (q, got, exact)
+    # the mean holds the same relative bound
+    assert 1 / tol <= sk.mean() / float(np.mean(vals)) <= tol
+
+
+def test_sketch_merge_associative_and_lossless():
+    """(a+b)+c == a+(b+c) bucket-for-bucket, and either equals the
+    sketch of the concatenated sample — merge loses nothing."""
+    rng = np.random.RandomState(9)
+    parts = [np.exp(rng.randn(n)) * s
+             for n, s in ((400, 10.0), (300, 200.0), (500, 1.0))]
+
+    def _sk(arrays):
+        sk = tracing.LatencySketch(1.05)
+        for a in arrays:
+            for v in a:
+                sk.record(float(v))
+        return sk
+
+    a, b, c = (_sk([p]) for p in parts)
+    left = _sk([parts[0]]).merge(_sk([parts[1]])).merge(_sk([parts[2]]))
+    right_bc = _sk([parts[1]]).merge(_sk([parts[2]]))
+    right = _sk([parts[0]]).merge(right_bc)
+    whole = _sk(parts)
+    for other in (right, whole):
+        assert left.buckets == other.buckets
+        assert left.zero == other.zero
+    assert left.count == sum(len(p) for p in parts)
+    # round-trips through the dump serialization unchanged
+    back = tracing.LatencySketch.from_dict(
+        json.loads(json.dumps(whole.to_dict())))
+    assert back.buckets == whole.buckets and back.zero == whole.zero
+    assert back.quantile(0.99) == whole.quantile(0.99)
+
+
+def test_sketch_zero_bucket_and_guardrails():
+    sk = tracing.LatencySketch()
+    sk.record(0.0)
+    sk.record(-5.0)
+    sk.record(1.0)
+    assert sk.zero == 2 and sk.count == 3
+    assert sk.quantile(0.5) == 0.0
+    with pytest.raises(ValueError):
+        tracing.LatencySketch(1.0001)
+    with pytest.raises(ValueError):
+        tracing.LatencySketch(2.5)
+    with pytest.raises(ValueError):
+        tracing.LatencySketch(1.05).merge(tracing.LatencySketch(1.1))
+
+
+# ================================================== (d) dump on fault
+
+
+def _train_with_recorder(tmp_path, iters=6):
+    rng = np.random.RandomState(7)
+    x = rng.randn(400, 5)
+    y = (x[:, 0] > 0).astype(np.float32)
+    ds = Dataset.from_arrays(x, y, max_bin=32)
+    return lgb.train({"objective": "binary", "num_leaves": 7,
+                      "min_data_in_leaf": 10,
+                      "min_sum_hessian_in_leaf": 1.0,
+                      "num_iterations": iters, "learning_rate": 0.2,
+                      "bagging_fraction": 0.5, "bagging_freq": 1}, ds)
+
+
+def test_dump_on_injected_fault_is_valid_jsonl(tmp_path):
+    """faults raise-kind hatch: the ring flushes a parseable dump with
+    reason fault:injected_raise BEFORE the raise escapes, and the dump
+    passes trace_report --check."""
+    # a real sink: per-iteration records (and so the recorder's
+    # train_iter events) ride the metrics_out path, like the shipped
+    # cli wiring that arms the recorder
+    telemetry.enable(str(tmp_path / "metrics.jsonl"))
+    telemetry.reset()
+    tracing.arm(ring_events=1024, dump_dir=str(tmp_path))
+    faults.arm(2, "raise")
+    try:
+        with pytest.raises(RuntimeError, match="injected fault"):
+            _train_with_recorder(tmp_path)
+    finally:
+        faults.disarm()
+        tracing.disarm()
+        telemetry.disable()
+        telemetry.reset()
+    dumps = [f for f in os.listdir(str(tmp_path))
+             if f.startswith("trace-") and f.endswith(".jsonl")]
+    assert dumps, "fault path left no trace dump"
+    path = str(tmp_path / sorted(dumps)[0])
+    header, events = trace_report.load(path)
+    assert header["reason"] == "fault:injected_raise"
+    assert events, "fault dump retained no events"
+    kinds = {e["kind"] for e in events}
+    assert "train_iter" in kinds
+    assert "bagging_draw" in kinds
+    assert trace_report.check(path, header, events) == []
+
+
+def test_clean_close_dumps_and_training_events_recorded(tmp_path):
+    """telemetry.disable() disarms the recorder, which flushes a
+    reason=close dump; the ring holds the training timeline (train_iter
+    + bagging draws) and the train_iter_us sketch saw every iteration."""
+    telemetry.enable(str(tmp_path / "metrics.jsonl"))
+    telemetry.reset()
+    tracing.arm(dump_dir=str(tmp_path))
+    try:
+        _train_with_recorder(tmp_path, iters=5)
+        snap = tracing.snapshot()
+        assert snap["sketches"]["train_iter_us"]["count"] == 5
+        assert snap["default_ring"] is True
+    finally:
+        telemetry.disable()   # disarms tracing -> dumps reason=close
+        telemetry.reset()
+    assert not tracing.active()
+    dumps = [f for f in os.listdir(str(tmp_path))
+             if f.startswith("trace-")]
+    assert len(dumps) == 1
+    header, events = trace_report.load(str(tmp_path / dumps[0]))
+    assert header["reason"] == "close"
+    assert sum(1 for e in events if e["kind"] == "train_iter") == 5
+    assert telemetry.counters() == {}   # reset cleared the mirror
+
+
+def test_trace_report_check_catches_violations(tmp_path):
+    """--check fails on a broken identity, an enqueue ordered after its
+    completion, wrong header bookkeeping, and unparseable JSONL."""
+    telemetry.enable(None)
+    tracing.arm(ring_events=64)
+    tracing.event("serve_enqueue", trace=1, rows=4, t_ns=100)
+    tracing.record_serve_request(1, None, 100, 1100,
+                                 (200, 300, 400, 500, 900), rows=4)
+    path = str(tmp_path / "ok.jsonl")
+    tracing.dump(path=path, reason="test")
+    tracing.disarm()
+    telemetry.disable()
+    telemetry.reset()
+    header, events = trace_report.load(path)
+    assert trace_report.check(path, header, events) == []
+
+    def _rewrite(name, header, events):
+        p = str(tmp_path / name)
+        with open(p, "w") as f:
+            f.write(json.dumps({"trace_header": header}) + "\n")
+            for e in events:
+                f.write(json.dumps(e) + "\n")
+        return p
+
+    # broken identity
+    bad = [dict(e) for e in events]
+    bad[-1] = dict(bad[-1], components_ns=dict(
+        bad[-1]["components_ns"], walk=bad[-1]["components_ns"]["walk"]
+        + 1))
+    p = _rewrite("bad_identity.jsonl", header, bad)
+    found = trace_report.check(p, *trace_report.load(p)[0:2])
+    assert any("identity" in f for f in found)
+    # enqueue after completion
+    p = _rewrite("bad_order.jsonl", header, [events[1], events[0]])
+    found = trace_report.check(p, *trace_report.load(p)[0:2])
+    assert any("AFTER" in f for f in found)
+    # header bookkeeping drift
+    p = _rewrite("bad_header.jsonl", dict(header, events=7), events)
+    found = trace_report.check(p, *trace_report.load(p)[0:2])
+    assert any("lines present" in f for f in found)
+    # unparseable JSONL
+    p = str(tmp_path / "junk.jsonl")
+    with open(p, "w") as f:
+        f.write('{"trace_header": {}}\n{not json\n')
+    with pytest.raises(trace_report.BadDump):
+        trace_report.load(p)
+    # completion with no enqueue is tolerated ONLY when events dropped
+    orphan = [events[1]]
+    p = _rewrite("orphan0.jsonl",
+                 dict(header, events=1, appended=1, dropped=0), orphan)
+    found = trace_report.check(p, *trace_report.load(p)[0:2])
+    assert any("no enqueue" in f for f in found)
+    p = _rewrite("orphan1.jsonl",
+                 dict(header, events=1, appended=2, dropped=1), orphan)
+    assert trace_report.check(p, *trace_report.load(p)[0:2]) == []
+
+
+# ======================================== (e) lifecycle + config knobs
+
+
+def test_leak_guard_sees_armed_recorder():
+    """The trace-recorder lifecycle probe: armed shows up in leaks(),
+    its closer disarms, and telemetry.disable() also disarms."""
+    tracing.arm(ring_events=16)
+    leaked = [(k, n, c) for k, n, c in lifecycle.leaks()
+              if k == "trace-recorder"]
+    assert leaked, "armed recorder invisible to the lifecycle registry"
+    leaked[0][2]()                # the probe's closer (what conftest runs)
+    assert not tracing.active()
+    tracing.arm(ring_events=16)
+    telemetry.disable()
+    assert not tracing.active()
+    telemetry.reset()
+
+
+def test_disarmed_recorder_is_inert():
+    assert not tracing.active()
+    assert tracing.next_trace_id() == 0
+    tracing.event("tick")          # all no-ops, nothing raises
+    tracing.observe("serve_wall_us", 1.0)
+    assert tracing.snapshot() == {}
+    assert tracing.dump(reason="test") is None
+    comps = tracing.record_serve_request(0, None, 0, 100,
+                                         (10, 20, 30, 40, 50), rows=1)
+    assert sum(comps.values()) == 100
+
+
+def test_config_knobs_reject_junk_loudly(tmp_path):
+    from lightgbm_tpu.config import OverallConfig
+    with pytest.raises(LightGBMError):
+        OverallConfig().set({"trace_ring_events": "0"}, require_data=False)
+    with pytest.raises(LightGBMError):
+        OverallConfig().set({"trace_sketch_growth": "3.0"},
+                            require_data=False)
+    with pytest.raises(LightGBMError):
+        OverallConfig().set({"trace_sketch_growth": "1.00001"},
+                            require_data=False)
+    # a dump dir that cannot exist (parent is a FILE) rejects at parse
+    blocker = tmp_path / "blocker"
+    blocker.write_text("x")
+    with pytest.raises(LightGBMError):
+        OverallConfig().set(
+            {"trace_dump_dir": str(blocker / "sub")}, require_data=False)
+    # valid values round-trip
+    cfg = OverallConfig()
+    cfg.set({"trace_ring_events": "128",
+             "trace_sketch_growth": "1.2",
+             "trace_dump_dir": str(tmp_path / "dumps")},
+            require_data=False)
+    assert cfg.io_config.trace_ring_events == 128
+    assert cfg.io_config.trace_sketch_growth == 1.2
+    assert os.path.isdir(str(tmp_path / "dumps"))
+    with pytest.raises(ValueError):
+        tracing.arm(ring_events=0)
+    with pytest.raises(ValueError):
+        tracing.arm(sketch_growth=9.0)
