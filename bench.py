@@ -596,6 +596,9 @@ INGEST_COPY_KEYS = (
     "ingest_sync_rows_per_sec", "ingest_overlap_speedup",
     "ingest_h2d_gbps", "ingest_peak_rss_bytes",
     "ingest_rss_bound_bytes", "ingest_rss_ok", "ingest_trained_iters",
+    # phase attribution (ISSUE 17): the recorded rounds EXPLAIN an
+    # ingest_rows_per_sec move instead of just re-measuring it
+    "ingest_parse_pct", "ingest_bin_pct", "ingest_h2d_pct",
 )
 
 
@@ -722,6 +725,13 @@ def bench_ingest(args) -> int:
         samples.append(rps)
     c1 = dict(telemetry.counters())
     h2d = c1.get("ingest/h2d_bytes", 0) - c0.get("ingest/h2d_bytes", 0)
+    # tokenizer/bin/H2D attribution over the timed (async) repeats —
+    # percentages of the accounted pass-2 time, so the three keys sum
+    # to ~100 and a regression names its phase
+    phase_us = {k: c1.get("ingest/%s_us" % k, 0)
+                - c0.get("ingest/%s_us" % k, 0)
+                for k in ("parse", "bin", "h2d")}
+    phase_total = sum(phase_us.values())
     timed_s = sum(rows / s for s in samples)
     sync_samples = [load_once(sync=True)[1]
                     for _ in range(max(1, args.repeats))]
@@ -785,6 +795,13 @@ def bench_ingest(args) -> int:
         "ingest_rss_bound_bytes": rss_bound,
         "ingest_rss_ok": rss_ok,
         "ingest_trained_iters": trained,
+        "ingest_parse_pct": (round(100.0 * phase_us["parse"]
+                                   / phase_total, 2)
+                             if phase_total > 0 else None),
+        "ingest_bin_pct": (round(100.0 * phase_us["bin"] / phase_total, 2)
+                           if phase_total > 0 else None),
+        "ingest_h2d_pct": (round(100.0 * phase_us["h2d"] / phase_total, 2)
+                           if phase_total > 0 else None),
     }
     out["ingest_spread"] = out["spread"]
     print(json.dumps(out))

@@ -168,6 +168,7 @@ KNOB_INVENTORY = {
     "trace_ring_events": "flight-recorder event-ring slots (drops oldest)",
     "trace_dump_dir": "flight-recorder JSONL dump dir (close + fault)",
     "trace_sketch_growth": "latency-sketch log-bucket growth factor",
+    "trace_run_id": "run tag in dump headers (podtrace merge key)",
     # serving
     "predict_buckets": "compiled batch-shape ladder (comma ints)",
     "predict_quantize": "float32 or int8 leaf-value serving tables",
@@ -270,6 +271,7 @@ class Application:
             # flight recorder (ISSUE 16): always-on under the telemetry
             # session — bounded by the preallocated ring, disarmed (and
             # dumped, when trace_dump_dir is set) by telemetry.disable()
+            tracing.set_identity(run_id=io.trace_run_id)
             tracing.arm(ring_events=io.trace_ring_events,
                         dump_dir=io.trace_dump_dir or None,
                         sketch_growth=io.trace_sketch_growth)
@@ -315,6 +317,15 @@ class Application:
             # shards (the clock handshake ran inside init_distributed)
             if self.config.io_config.timeline_enabled():
                 telemetry.set_timeline(True)
+            # pod identity is final here too: trace dumps from every
+            # process must carry matching (index, count) or podtrace's
+            # merge refuses the set
+            try:
+                import jax as _jax
+                tracing.set_identity(process_index=_jax.process_index(),
+                                     process_count=_jax.process_count())
+            except Exception:
+                pass
 
         self.boosting = GBDT()
         predict_fun = None

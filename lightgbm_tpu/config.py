@@ -181,6 +181,11 @@ class IOConfig:
     # trace_sketch_growth: log-bucket growth factor of the percentile
     # sketches — quantiles are exact to within a factor sqrt(growth)
     trace_sketch_growth: float = 1.05
+    # trace_run_id: operator-assigned run tag stamped into every trace
+    # dump header.  podtrace/pod_report refuse to merge dumps with
+    # mismatched run ids (mixing runs is a loud BadDump, never a
+    # silently wrong merge); "" leaves dumps untagged.
+    trace_run_id: str = ""
     output_result: str = "LightGBM_predict_result.txt"
     input_model: str = ""
     input_init_score: str = ""
@@ -341,6 +346,14 @@ class IOConfig:
                                               self.trace_sketch_growth)
         log.check(1.0005 <= self.trace_sketch_growth <= 2.0,
                   "trace_sketch_growth should be in [1.0005, 2.0]")
+        if "trace_run_id" in params:
+            value = str(params["trace_run_id"])
+            log.check(len(value) <= 128
+                      and not any(c.isspace() for c in value),
+                      "trace_run_id must be <= 128 chars with no "
+                      "whitespace (it lands verbatim in dump headers "
+                      "and report keys)")
+            self.trace_run_id = value
         self.num_model_predict = _get_int(params, "num_model_predict", self.num_model_predict)
         self.predict_buckets = _get_str(params, "predict_buckets",
                                         self.predict_buckets)

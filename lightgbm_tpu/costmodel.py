@@ -115,22 +115,27 @@ def reset() -> None:
 
 # Per-chip ceilings, flop convention matching XLA cost analysis (one FMA =
 # 2 flops; the marketing "TFLOPS" numbers already count it that way).
+# ici_bytes_per_sec is the per-chip aggregate inter-chip-interconnect
+# egress (one direction, all links), from the public per-chip interchip
+# bandwidth specs — the seam-roofline denominator podtrace divides
+# measured collective GB/s by.  A logical-payload seam can't exceed it,
+# so attained/peak is a conservative (under-)estimate of link saturation.
 _PEAK_TABLE: Tuple[Tuple[Tuple[str, ...], Dict[str, float]], ...] = (
     (("v6e", "v6 lite", "trillium"),
      {"flops_per_sec": 918e12, "int8_ops_per_sec": 1836e12,
-      "hbm_bytes_per_sec": 1640e9}),
+      "hbm_bytes_per_sec": 1640e9, "ici_bytes_per_sec": 448e9}),
     (("v5p",),
      {"flops_per_sec": 459e12, "int8_ops_per_sec": 918e12,
-      "hbm_bytes_per_sec": 2765e9}),
+      "hbm_bytes_per_sec": 2765e9, "ici_bytes_per_sec": 600e9}),
     (("v5e", "v5 lite", "v5lite"),
      {"flops_per_sec": 197e12, "int8_ops_per_sec": 394e12,
-      "hbm_bytes_per_sec": 819e9}),
+      "hbm_bytes_per_sec": 819e9, "ici_bytes_per_sec": 200e9}),
     (("v4",),
      {"flops_per_sec": 275e12, "int8_ops_per_sec": 275e12,
-      "hbm_bytes_per_sec": 1228e9}),
+      "hbm_bytes_per_sec": 1228e9, "ici_bytes_per_sec": 300e9}),
     (("v3",),
      {"flops_per_sec": 123e12, "int8_ops_per_sec": 123e12,
-      "hbm_bytes_per_sec": 900e9}),
+      "hbm_bytes_per_sec": 900e9, "ici_bytes_per_sec": 280e9}),
 )
 
 

@@ -34,11 +34,12 @@ Collectives (wire sites ``elastic/times_allgather`` and
 from __future__ import annotations
 
 import functools
+import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from . import telemetry
+from . import telemetry, tracing
 from .utils import log
 
 CANONICAL_PHASES = ("histogram", "split_find", "partition", "eval")
@@ -258,12 +259,18 @@ def mapped_vote_fn(mesh):
     return shard_map(fn, mesh=mesh, in_specs=(P(),), out_specs=P())
 
 
-def exchange_times(mesh, seconds: float) -> np.ndarray:
+def exchange_times(mesh, seconds: float,
+                   iteration: Optional[int] = None) -> np.ndarray:
     """All hosts' per-iteration seconds, gathered device-slot-wise over
     the (flattened) mesh: returns the identical [n_devices] float32
     vector on every host.  Single-process meshes yield a constant vector
     (one host's clock) — the monitor's strictly-slowest rule then never
-    fires, by design."""
+    fires, by design.
+
+    When ``iteration`` is given, the EXECUTED blocked window (both
+    wall-clock edges of the host-side sync on the gathered result) files
+    a ``collective_sync`` flight-recorder event — podtrace's clock-
+    alignment sync point when the gather truly spans processes."""
     import jax
     import jax.numpy as jnp
     mesh1d = _flat_mesh(mesh)
@@ -272,7 +279,8 @@ def exchange_times(mesh, seconds: float) -> np.ndarray:
     if prog is None:
         prog = _TIMES_PROGRAMS[key] = jax.jit(mapped_times_fn(mesh1d))
     n = int(np.asarray(mesh1d.devices).size)
-    if jax.process_count() > 1:
+    pod = jax.process_count() > 1
+    if pod:
         from jax.sharding import NamedSharding, PartitionSpec
         from .parallel.mesh import DATA_AXIS
         local = np.full(jax.local_device_count(), np.float32(seconds))
@@ -281,13 +289,21 @@ def exchange_times(mesh, seconds: float) -> np.ndarray:
     else:
         arr = jnp.full((n,), np.float32(seconds))
     with telemetry.span("elastic"):
+        t0 = time.time()
         out = np.asarray(prog(arr))
+        if iteration is not None:
+            tracing.record_collective_sync("elastic/times_allgather",
+                                           iteration, t0, time.time(),
+                                           pod=pod)
     return out
 
 
-def agree_survivors(mesh, votes: np.ndarray) -> np.ndarray:
+def agree_survivors(mesh, votes: np.ndarray,
+                    iteration: Optional[int] = None) -> np.ndarray:
     """Elementwise minimum of every host's int32 vote vector (replicated
-    shapes); the agreed plan all survivors act on."""
+    shapes); the agreed plan all survivors act on.  ``iteration`` files
+    the executed blocked window as a ``collective_sync`` event, like
+    :func:`exchange_times`."""
     import jax
     import jax.numpy as jnp
     mesh1d = _flat_mesh(mesh)
@@ -296,7 +312,12 @@ def agree_survivors(mesh, votes: np.ndarray) -> np.ndarray:
     if prog is None:
         prog = _VOTE_PROGRAMS[key] = jax.jit(mapped_vote_fn(mesh1d))
     with telemetry.span("elastic"):
+        t0 = time.time()
         out = np.asarray(prog(jnp.asarray(np.asarray(votes, np.int32))))
+        if iteration is not None:
+            tracing.record_collective_sync("elastic/survivor_pmin",
+                                           iteration, t0, time.time(),
+                                           pod=jax.process_count() > 1)
     return out
 
 

@@ -75,7 +75,7 @@ from typing import List, Optional
 
 import numpy as np
 
-from .. import hatches, telemetry
+from .. import hatches, telemetry, tracing
 from ..utils import log
 from . import parser as parser_mod
 
@@ -350,9 +350,12 @@ def load_train_streaming(ds, io_config, parser, rank: int,
 
     with telemetry.span("ingest"):
         # ---- pass 0: count data rows (raw scan, no parse)
+        t_pass = time.perf_counter()
         with telemetry.span("ingest_count"):
             total_rows = parser_mod.count_data_rows(
                 filename, skip_header=io_config.has_header)
+        tracing.record_ingest_pass(0, time.perf_counter() - t_pass,
+                                   total_rows)
         ds.global_num_data = total_rows
         sample_cnt = dataset_mod.SAMPLE_CNT
         sample_idx = pinned_sample_indices(
@@ -366,6 +369,7 @@ def load_train_streaming(ds, io_config, parser, rank: int,
         reservoir = None
         num_cols = None
         start = 0
+        t_pass = time.perf_counter()
         with telemetry.span("ingest_pass1"):
             for lines in parser_mod.prefetch_chunks(
                     parser_mod.read_line_chunks(
@@ -395,6 +399,7 @@ def load_train_streaming(ds, io_config, parser, rank: int,
                     if hi > lo:
                         reservoir[lo:hi] = feats[sample_idx[lo:hi] - start]
                 start += c
+        tracing.record_ingest_pass(1, time.perf_counter() - t_pass, start)
         log.check(start == total_rows,
                   "Input file changed between the streaming passes "
                   f"(pass 0: {total_rows} rows, pass 1: {start})")
@@ -465,17 +470,27 @@ def load_train_streaming(ds, io_config, parser, rank: int,
         init_scores = [] if predict_fun is not None else None
         cursor = 0
         start = 0
+        chunk_no = 0
+        t_pass = time.perf_counter()
         try:
             for lines in parser_mod.prefetch_chunks(
                     parser_mod.read_line_chunks(
                         filename, skip_header=io_config.has_header,
                         chunk_lines=chunk_rows)):
                 with telemetry.span("ingest_bin"):
+                    # per-chunk tokenizer/bin/H2D split (ISSUE 17): the
+                    # attribution that turns an ingest_rows_per_sec
+                    # regression into a named phase.  perf_counter pairs
+                    # around the three stages; the spans above stay the
+                    # coarse (gated) lane.
+                    t0 = time.perf_counter()
                     feats = parser.parse(lines).features
                     c0 = feats.shape[0]
                     if mask is not None:
                         feats = feats[mask[start:start + c0]]
+                    t1 = time.perf_counter()
                     n = feats.shape[0]
+                    t2 = t_h2d = t1
                     if n:
                         binned = np.empty((F_used, n), dtype=dtype)
                         for j_raw, j_inner in ds.used_feature_map.items():
@@ -486,17 +501,36 @@ def load_train_streaming(ds, io_config, parser, rank: int,
                             init_scores.append(np.asarray(
                                 predict_fun(feats),
                                 np.float32).reshape(-1))
+                        t2 = time.perf_counter()
                         if cache is not None:
                             cache.write(binned, cursor)
                         writer.append(binned, cursor)
+                        t_h2d = time.perf_counter()
+                parse_us = (t1 - t0) * 1e6
+                bin_us = (t2 - t1) * 1e6
+                h2d_us = (t_h2d - t2) * 1e6
                 telemetry.count("ingest/chunks")
                 telemetry.count("ingest/rows", n)
+                telemetry.count("ingest/parse_us", int(parse_us))
+                telemetry.count("ingest/bin_us", int(bin_us))
+                telemetry.count("ingest/h2d_us", int(h2d_us))
+                tracing.record_ingest_chunk(2, chunk_no, n, parse_us,
+                                            bin_us, h2d_us)
+                chunk_no += 1
                 cursor += n
                 start += c0
             log.check(start == total_rows and cursor == ds.num_data,
                       "Input file changed between the streaming passes "
                       f"(pass 1: {total_rows} rows, pass 2: {start})")
+            tracing.record_ingest_pass(2, time.perf_counter() - t_pass,
+                                       cursor)
+            # the final drain (device_put commit / in-flight transfers)
+            # belongs to the H2D phase too — without it the attribution
+            # would under-report exactly the part that scales with data
+            t_fin = time.perf_counter()
             out = writer.finish()
+            telemetry.count("ingest/h2d_us",
+                            int((time.perf_counter() - t_fin) * 1e6))
             if device_resident:
                 ds.device_bins = out
                 ds.bins = None

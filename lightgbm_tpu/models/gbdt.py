@@ -1017,7 +1017,8 @@ class GBDT:
             if self._boundary_t is not None:
                 from .. import elastic as elastic_mod
                 gathered = elastic_mod.exchange_times(
-                    self._learner._mesh(), now - self._boundary_t)
+                    self._learner._mesh(), now - self._boundary_t,
+                    iteration=self._consumed_iteration())
                 mon.observe(self._consumed_iteration(),
                             elastic_mod.host_times_from_gather(
                                 gathered,
@@ -1095,7 +1096,8 @@ class GBDT:
         votes[drop_slot] = 0
         if hasattr(self._learner, "_mesh"):
             agreed = elastic_mod.agree_survivors(self._learner._mesh(),
-                                                 votes)
+                                                 votes,
+                                                 iteration=state["iteration"])
             new_m = int(np.asarray(agreed).sum())
         else:
             new_m = cur - 1

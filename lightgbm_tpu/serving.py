@@ -707,8 +707,12 @@ class ServingFront:
             # contract trace_report --check validates.  tracing._lock is
             # a leaf lock; tracing never calls back into the front.
             if tracing.active():
+                # depth_rows = rows already queued AHEAD of this request
+                # at its enqueue instant (own rows excluded) — the
+                # SLO-prep signal the adaptive-linger design needs
                 tracing.event("serve_enqueue", trace=req.trace_id, rows=n,
-                              t_ns=t_enq_ns)
+                              t_ns=t_enq_ns,
+                              depth_rows=self._queued_rows - n)
                 if blocked:
                     tracing.event("serve_backpressure", trace=req.trace_id,
                                   block_ns=req.block_ns)
@@ -894,6 +898,11 @@ class ServingFront:
                               requests=len(batch), rows=total,
                               bucket=bt.bucket, pad_rows=bt.pad_rows,
                               wait_us=int(wait_s * 1e6))
+                # per-bucket dispatch tallies ride the dump header (the
+                # ladder occupancy the express-lane design needs)
+                tracing.bump("serve/dispatch_bucket_%d" % bt.bucket)
+                tracing.bump("serve/dispatch_rows_bucket_%d" % bt.bucket,
+                             total)
                 bounds = (t_linger_ns, t_form_ns, bt.run_begin_ns,
                           bt.dispatched_ns, t_scores_ns)
             ofs = 0

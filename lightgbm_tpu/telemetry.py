@@ -248,12 +248,15 @@ COUNTER_FAMILIES = (
     "hist/xla_int8",
     "hist/xla_int_kernel",
     "hist/xla_matmul",
+    "ingest/bin_us",
     "ingest/chunks",
     "ingest/double_buffer_off",
     "ingest/double_buffer_on",
     "ingest/h2d_bytes",
+    "ingest/h2d_us",
     "ingest/h2d_wait_us",
     "ingest/overlap_hidden_us",
+    "ingest/parse_us",
     "ingest/rows",
     "jit/backend_compile",
     "jit/midrun_recompile",
@@ -471,6 +474,18 @@ def disable() -> None:
     disarm_watchdog()
     try:
         from . import tracing
+        # stamp the session's per-site wire byte model into the ring
+        # before the close dump: podtrace's seam roofline joins measured
+        # collective_sync spans against exactly this model, and a dump
+        # that carries it is self-contained on crash-forensics hosts
+        snap = interconnect_snapshot()
+        if snap and tracing.active():
+            tracing.event("wire_model", sites={
+                s: {"est_bytes": rec.get("est_bytes", 0),
+                    "bytes_per_call": rec.get("bytes_per_call", 0),
+                    "est_calls": rec.get("est_calls", 0),
+                    "kind": rec.get("kind"), "axis": rec.get("axis")}
+                for s, rec in snap.get("sites", {}).items()})
         tracing.disarm()
     except Exception:
         pass
@@ -840,6 +855,17 @@ def set_shard_identity(index: Optional[int] = None,
     global _shard_identity
     _shard_identity = (None if index is None or count is None
                        else (int(index), int(count)))
+    # keep the flight recorder's pod identity in lockstep — dumps and
+    # timeline shards must agree on who "p<i>" is (podtrace merge key)
+    try:
+        from . import tracing
+        if _shard_identity is None:
+            tracing.set_identity(process_index=None, process_count=None)
+        else:
+            tracing.set_identity(process_index=_shard_identity[0],
+                                 process_count=_shard_identity[1])
+    except Exception:
+        pass
 
 
 def _shard_suffix() -> "tuple[int, int]":

@@ -45,6 +45,18 @@ boundaries, checkpoint write/drop, GOSS/bagging draws and elastic
 shrinks — so one dump explains both a slow request and a stalled
 training loop.
 
+Pod scope (ISSUE 17): every dump header carries the recording host's
+identity — hostname, (process_index, process_count) when known, and the
+operator-assigned ``run_id`` (:func:`set_identity`) — so
+``lightgbm_tpu/podtrace.py`` can align per-host clocks on matched
+``collective_sync`` events (:func:`record_collective_sync` stamps both
+edges of a blocking collective, the honest offset bound) and merge the
+rings into one global timeline; sketches merge via the associative
+bucket addition above.  Ingest attribution
+(:func:`record_ingest_chunk` / :func:`record_ingest_pass`) and the
+small monotone :func:`bump` counters (serialized in the header) ride
+the same ring.
+
 Counter contract (censused by graftlint D1): the recorder mirrors
 ``trace/dropped`` (ring overwrites) and ``trace/dumps`` (dump files
 written) into the telemetry registry; the dump writer runs under the
@@ -58,6 +70,7 @@ from __future__ import annotations
 import json
 import math
 import os
+import socket
 import threading
 import time
 from typing import Dict, List, Optional
@@ -189,7 +202,45 @@ _sketches: Dict[str, LatencySketch] = {}
 _trace_seq = 0
 _batch_seq = 0
 _dumps = 0
+_counters: Dict[str, int] = {}
 _tls = threading.local()
+
+# host/process identity stamped into every dump header (pod-scope merge
+# key).  Survives arm/disarm — it describes the PROCESS, not the session
+# — and is overwritten, never merged: latest set_identity() wins.
+_host = socket.gethostname()
+_process_index: Optional[int] = None
+_process_count: Optional[int] = None
+_run_id = ""
+_UNSET = object()
+
+
+def set_identity(process_index=_UNSET, process_count=_UNSET,
+                 run_id=_UNSET) -> None:
+    """Install the recorder's pod identity: ``(process_index,
+    process_count)`` from the distributed runtime (telemetry pushes it
+    when shard identity resolves) and the operator-assigned ``run_id``
+    (``trace_run_id`` knob) that marks which dumps belong to one run.
+    Omitted arguments keep their current value; pass ``None`` (or ``""``
+    for run_id) to clear.  Callable before or after :func:`arm`."""
+    global _process_index, _process_count, _run_id
+    with _lock:
+        if process_index is not _UNSET:
+            _process_index = (None if process_index is None
+                              else int(process_index))
+        if process_count is not _UNSET:
+            _process_count = (None if process_count is None
+                              else int(process_count))
+        if run_id is not _UNSET:
+            _run_id = str(run_id or "")
+
+
+def identity() -> dict:
+    """The header identity block as it would be dumped right now."""
+    with _lock:
+        return {"host": _host, "pid": os.getpid(),
+                "process_index": _process_index,
+                "process_count": _process_count, "run_id": _run_id}
 
 
 def active() -> bool:
@@ -232,6 +283,7 @@ def arm(ring_events: int = DEFAULT_RING_EVENTS, dump_dir: str = "",
         _dump_dir = str(dump_dir or "")
         _growth = float(sketch_growth)
         _sketches.clear()
+        _counters.clear()
         _trace_seq = 0
         _batch_seq = 0
         _dumps = 0
@@ -257,6 +309,7 @@ def disarm() -> Optional[str]:
         _appended = 0
         _dump_dir = ""
         _sketches.clear()
+        _counters.clear()
     _tls.batch = None
     return path
 
@@ -318,6 +371,88 @@ def observe(family: str, value_us: float) -> None:
     with _lock:
         if _armed:
             _observe_locked(family, value_us)
+
+
+def bump(name: str, n: int = 1) -> None:
+    """Increment a small monotone per-session counter (serialized into
+    the dump header's ``counters`` block — per-bucket dispatch counts
+    and other SLO-prep tallies too cheap and too many for the telemetry
+    registry's censused families).  No-op while disarmed."""
+    if not _armed:
+        return
+    with _lock:
+        if _armed:
+            _counters[name] = _counters.get(name, 0) + int(n)
+
+
+def counter(name: str) -> int:
+    with _lock:
+        return _counters.get(name, 0)
+
+
+def record_collective_sync(site: str, iteration: int,
+                           t_begin_s: float, t_end_s: float,
+                           pod: bool = False) -> None:
+    """File one executed blocking collective: both wall-clock edges of
+    the host-side block (NOT the trace-time record telemetry keeps).
+
+    Every participant exits a collective within its own blocked window
+    of the last arrival, so matched ``(site, iter)`` exit stamps across
+    hosts estimate the inter-host clock offset with error bounded by
+    ``max(duration_a, duration_b)`` — podtrace records that bound, never
+    pretending better.  ``pod=True`` marks a collective that actually
+    spanned processes (process_count > 1); only those are valid
+    alignment sync points — a process-local collective is a seam timing
+    sample but says nothing about another host's clock."""
+    if not _armed:
+        return
+    t0, t1 = float(t_begin_s), float(t_end_s)
+    dur_us = max(t1 - t0, 0.0) * 1e6
+    ev = {"kind": "collective_sync", "t": round(t1, 6),
+          "site": str(site), "iter": int(iteration),
+          "t0": round(t0, 6), "t1": round(t1, 6),
+          "dur_us": round(dur_us, 1), "pod": bool(pod)}
+    with _lock:
+        if _armed:
+            _append_locked(ev)
+            _observe_locked("collective_sync_us", dur_us)
+
+
+def record_ingest_pass(pass_no: int, seconds: float, rows: int) -> None:
+    """File one completed ingest pass (0 = row count, 1 = feature/label
+    scan, 2 = tokenize+bin+H2D) — the coarse lane of the ingest
+    attribution story."""
+    if not _armed:
+        return
+    ev = {"kind": "ingest_pass", "t": round(time.time(), 6),
+          "pass": int(pass_no), "seconds": round(float(seconds), 6),
+          "rows": int(rows)}
+    with _lock:
+        if _armed:
+            _append_locked(ev)
+
+
+def record_ingest_chunk(pass_no: int, chunk: int, rows: int,
+                        parse_us: float, bin_us: float,
+                        h2d_us: float) -> None:
+    """File one streamed chunk's phase split — tokenizer (parse) vs
+    value->bin mapping vs H2D handoff (device_put + row-writer append;
+    the async tail is priced by the ``ingest_h2d`` span at finish).
+    Sketches accumulate each phase so a dump explains WHERE the
+    declining ingest_rows_per_sec lane spends its time."""
+    if not _armed:
+        return
+    ev = {"kind": "ingest_chunk", "t": round(time.time(), 6),
+          "pass": int(pass_no), "chunk": int(chunk), "rows": int(rows),
+          "parse_us": round(float(parse_us), 1),
+          "bin_us": round(float(bin_us), 1),
+          "h2d_us": round(float(h2d_us), 1)}
+    with _lock:
+        if _armed:
+            _append_locked(ev)
+            _observe_locked("ingest_parse_us", float(parse_us))
+            _observe_locked("ingest_bin_us", float(bin_us))
+            _observe_locked("ingest_h2d_us", float(h2d_us))
 
 
 def next_trace_id() -> int:
@@ -495,6 +630,7 @@ def snapshot() -> dict:
             "sketch_growth": _growth,
             "sketches": {f: sk.percentiles()
                          for f, sk in sorted(_sketches.items())},
+            "counters": dict(sorted(_counters.items())),
         }
 
 
@@ -517,6 +653,10 @@ def dump(path: Optional[str] = None, reason: str = "close"
         header = {"trace_header": {
             "reason": str(reason),
             "pid": os.getpid(),
+            "host": _host,
+            "process_index": _process_index,
+            "process_count": _process_count,
+            "run_id": _run_id,
             "t": round(time.time(), 6),
             "ring_events": _cap,
             "events": len(events),
@@ -525,6 +665,7 @@ def dump(path: Optional[str] = None, reason: str = "close"
             "sketch_growth": _growth,
             "sketches": {f: sk.to_dict()
                          for f, sk in sorted(_sketches.items())},
+            "counters": dict(sorted(_counters.items())),
         }}
         dump_dir = _dump_dir
     if path is None:

@@ -37,6 +37,18 @@ round against the earlier trajectory:
   The block is read from the record itself or parsed out of the smoke
   run's ``tail`` (dryrun_multichip prints one ``MULTICHIP_OBS`` JSON
   line).
+- **pod-scope observability** (ISSUE 17): the ``MULTICHIP_PODTRACE``
+  line's merge bookkeeping.  Three ABSOLUTE findings need no trajectory
+  — ``alignment_ok`` False (a host's clock-offset estimates disagree
+  beyond the recorded collective-duration bounds, i.e. the alignment
+  error exceeded the bound the dump itself recorded),
+  ``check_findings``/``unmodeled`` nonzero (the real pod_report --check
+  contracts: header bookkeeping, event conservation, attribution
+  identity, byte-model coverage), and ``parity`` False (the post-mortem
+  straggler verdict diverged from the live StragglerTracker's over the
+  same measurements) — plus a must-not-grow lane on the normalized
+  merge overhead (``merge_ms_per_kevent``, wide observability floor:
+  tiny smokes, timing-noise-dominated).
 - **wire bytes** (ISSUE 9): the ``MULTICHIP_WIRE`` line's logical
   ``wire_bytes_per_iter`` per tree learner (data / hybrid / voting at
   the F=28, B=255 schema).  These are DETERMINISTIC — traced shapes x
@@ -262,6 +274,21 @@ def _attach_multichip_obs(rec: dict) -> None:
                 break
             if isinstance(el, dict):
                 rec["elastic"] = el
+            break
+    if "podtrace" not in rec:
+        # ISSUE 17: the pod-scope observability row prints one
+        # MULTICHIP_PODTRACE JSON line (two real processes -> per-host
+        # dumps -> pod_report --check on the merge)
+        for line in reversed(lines):
+            line = line.strip()
+            if not line.startswith("MULTICHIP_PODTRACE "):
+                continue
+            try:
+                pt = json.loads(line[len("MULTICHIP_PODTRACE "):])
+            except ValueError:
+                break
+            if isinstance(pt, dict):
+                rec["podtrace"] = pt
             break
 
 
@@ -529,6 +556,76 @@ def _check_multichip(entries: List[dict], findings: List[dict],
             })
 
 
+def _check_podtrace(entries: List[dict], findings: List[dict],
+                    floor: float = DEFAULT_FLOOR,
+                    sigma_mult: float = DEFAULT_SIGMA_MULT) -> None:
+    """ISSUE 17: the pod-merge bookkeeping from the MULTICHIP_PODTRACE
+    block.  Absolute contracts checked on EVERY round that recorded one
+    (these are correctness claims about that round's merge, not
+    trajectories): alignment error exceeding the dump's own recorded
+    collective-duration bound, any real pod_report --check finding, a
+    measured seam missing from the byte model, and live-vs-post-mortem
+    straggler verdict divergence.  The normalized merge overhead
+    (``merge_ms_per_kevent``) rides a must-not-grow lane at the wide
+    observability floor — the smoke merges a tiny ring, so only
+    order-of-magnitude breaks (an accidentally quadratic merge) are
+    signal."""
+    entries = sorted(entries, key=lambda e: e["round"])
+    for e in entries:
+        pt = e["rec"].get("podtrace")
+        if not isinstance(pt, dict):
+            continue
+        checks = (
+            ("alignment_ok", pt.get("alignment_ok") is False,
+             "a host's clock-offset estimates disagree beyond the "
+             "recorded collective-duration bounds — the alignment error "
+             "exceeded the bound the dumps themselves recorded"),
+            ("check_findings",
+             isinstance(pt.get("check_findings"), (int, float))
+             and pt["check_findings"] > 0,
+             "pod_report --check flagged merge-contract violations "
+             "(header bookkeeping / event conservation / attribution "
+             "identity)"),
+            ("unmodeled",
+             isinstance(pt.get("unmodeled"), (int, float))
+             and pt["unmodeled"] > 0,
+             "measured collective seam(s) missing from the wire byte "
+             "model — byte-model drift"),
+            ("parity", pt.get("parity") is False,
+             "the post-mortem straggler verdict diverged from the live "
+             "StragglerTracker's over the same measurements — the one-"
+             "rule contract is broken"),
+        )
+        for key, bad, detail in checks:
+            if bad:
+                findings.append({
+                    "metric": "multichip", "key": "podtrace/" + key,
+                    "latest_round": e["round"],
+                    "latest": pt.get(key), "baseline": None,
+                    "detail": detail,
+                })
+    series = [(e["round"], float(pt["merge_ms_per_kevent"]))
+              for e in entries
+              for pt in [e["rec"].get("podtrace")]
+              if isinstance(pt, dict) and isinstance(
+                  pt.get("merge_ms_per_kevent"), (int, float))
+              and pt["merge_ms_per_kevent"] > 0]
+    if len(series) < 2 or series[-1][0] != entries[-1]["round"]:
+        return
+    prior = [v for _, v in series[:-1]]
+    latest_v = series[-1][1]
+    baseline = _median(prior)
+    sigma = max(floor, _OBS_FLOOR) / 2.0
+    if baseline > 0 and latest_v > baseline * (1.0 + sigma_mult * sigma):
+        findings.append({
+            "metric": "multichip", "key": "podtrace/merge_ms_per_kevent",
+            "latest_round": series[-1][0],
+            "latest": latest_v, "baseline": round(baseline, 6),
+            "drop": round(latest_v / baseline - 1.0, 4),
+            "allowed_drop": round(sigma_mult * sigma, 4),
+        })
+
+
 def _check_wire(entries: List[dict], findings: List[dict],
                 floor: float = DEFAULT_FLOOR,
                 sigma_mult: float = DEFAULT_SIGMA_MULT) -> None:
@@ -606,6 +703,8 @@ def check_files(paths: List[str], floor: float = DEFAULT_FLOOR,
     if multichip:
         _check_wire(sorted(multichip, key=lambda e: e["round"]), findings,
                     floor=floor, sigma_mult=sigma_mult)
+        _check_podtrace(multichip, findings, floor=floor,
+                        sigma_mult=sigma_mult)
     return {
         "files": len(entries),
         "groups": {m: len(g) for m, g in sorted(groups.items())},

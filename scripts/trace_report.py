@@ -25,7 +25,13 @@ violation (2 on unreadable input), printing one line per finding:
     a dump whose header says nothing was dropped (dropped enqueues are
     tolerated — the ring drops oldest-first by design);
   - header bookkeeping: ``events`` not matching the event lines actually
-    present, or ``dropped != max(0, appended - events)``.
+    present, or ``dropped != max(0, appended - events)``;
+  - host/process identity bookkeeping (pod-scope dumps, ISSUE 17): a
+    ``process_index`` that is not an int in ``[0, process_count)``, a
+    non-positive ``process_count``, or a non-string host/run_id;
+  - multi-dump runs: passing dumps whose ``run_id`` headers disagree is
+    a loud BadDump (exit 2 without --check) — merging traces from
+    different runs is silently wrong, never a rendering choice.
 
 Standalone stdlib script — it parses dumps by schema (the component
 names mirror tracing.COMPONENTS) so it runs anywhere, including on dumps
@@ -92,6 +98,19 @@ def check(path: str, header: dict, events: list) -> list:
                    "events implies %d"
                    % (path, header.get("dropped"), appended, len(events),
                       want_drop))
+    idx, cnt = header.get("process_index"), header.get("process_count")
+    if cnt is not None and (not isinstance(cnt, int) or cnt < 1):
+        bad.append("%s: header process_count=%r is not a positive int"
+                   % (path, cnt))
+    if idx is not None:
+        if not isinstance(idx, int) or idx < 0 or (
+                isinstance(cnt, int) and cnt >= 1 and idx >= cnt):
+            bad.append("%s: header process_index=%r out of range for "
+                       "process_count=%r" % (path, idx, cnt))
+    for key in ("host", "run_id"):
+        if key in header and not isinstance(header[key], str):
+            bad.append("%s: header %s=%r is not a string"
+                       % (path, key, header[key]))
     dropped = int(header.get("dropped", 0))
     enq_pos = {}
     for pos, ev in enumerate(events):
@@ -139,6 +158,23 @@ def check(path: str, header: dict, events: list) -> list:
 def _nearest_rank(sorted_vals, q):
     n = len(sorted_vals)
     return sorted_vals[min(n - 1, max(0, int(math.ceil(q * n)) - 1))]
+
+
+def check_run_mix(loaded) -> Optional[str]:
+    """``[(path, header), ...]`` -> a finding when the dumps carry
+    disagreeing run_ids (None = one run, or untagged dumps).  Untagged
+    ("" / absent) headers mix with anything — pre-ISSUE-17 dumps stay
+    renderable — but two DIFFERENT non-empty tags never do."""
+    by_run = {}
+    for path, header in loaded:
+        rid = str(header.get("run_id") or "")
+        if rid:
+            by_run.setdefault(rid, []).append(path)
+    if len(by_run) > 1:
+        return ("mixing dumps from different runs: "
+                + "; ".join("run_id=%r (%s)" % (rid, ", ".join(paths))
+                            for rid, paths in sorted(by_run.items())))
+    return None
 
 
 def _sketch_quantile(sk: dict, q: float):
@@ -190,6 +226,11 @@ def summarize(header: dict, events: list) -> dict:
     out = {
         "reason": header.get("reason"),
         "pid": header.get("pid"),
+        "host": header.get("host"),
+        "process_index": header.get("process_index"),
+        "process_count": header.get("process_count"),
+        "run_id": header.get("run_id"),
+        "counters": header.get("counters") or {},
         "ring_events": header.get("ring_events"),
         "events": len(events),
         "appended": header.get("appended"),
@@ -223,8 +264,12 @@ def render(path: str, s: dict) -> str:
              "reason=%s pid=%s  ring %s/%s events (appended %s, "
              "dropped %s)"
              % (s.get("reason"), s.get("pid"), s.get("events"),
-                s.get("ring_events"), s.get("appended"), s.get("dropped")),
-             "", "Event kinds", "-----------"]
+                s.get("ring_events"), s.get("appended"), s.get("dropped"))]
+    if s.get("host") is not None or s.get("run_id"):
+        lines.append("host=%s process=%s/%s run_id=%r"
+                     % (s.get("host"), s.get("process_index"),
+                        s.get("process_count"), s.get("run_id") or ""))
+    lines += ["", "Event kinds", "-----------"]
     kinds = s.get("kinds") or {}
     if kinds:
         width = max(len(k) for k in kinds)
@@ -284,6 +329,7 @@ def main() -> int:
     args = p.parse_args()
     findings = []
     rc = 0
+    loaded = []
     for path in args.paths:
         try:
             header, events = load(path)
@@ -293,6 +339,16 @@ def main() -> int:
                 continue
             print("trace_report error: %s" % e, file=sys.stderr)
             return 2
+        loaded.append((path, header, events))
+    mix = check_run_mix([(p, h) for p, h, _e in loaded])
+    if mix is not None:
+        if not args.check:
+            # a cross-run batch is a BadDump, not a rendering choice
+            print("trace_report error: %s" % BadDump(mix),
+                  file=sys.stderr)
+            return 2
+        findings.append(mix)
+    for path, header, events in loaded:
         if args.check:
             findings.extend(check(path, header, events))
             continue

@@ -1,0 +1,415 @@
+"""Pod-scope observability (ISSUE 17, lightgbm_tpu/podtrace.py +
+scripts/pod_report.py + the multi-host half of scripts/trace_report.py).
+
+Correctness bars, in the ISSUE's order:
+
+(a) merge algebra: the merged timeline and the merged sketches are
+    independent of the order dumps are passed in, conserve every event
+    / every observation, and the sketch merge is associative;
+(b) clock alignment: on a synthetically skewed host pair the estimated
+    offset lands within the RECORDED collective-duration bound (the
+    bound is part of the answer, checked against ground truth), and
+    only pod-wide collectives qualify as sync points;
+(c) tampering / bookkeeping: a per-host dump whose attribution identity
+    was edited is caught by the pod check; mixed run ids are a loud
+    BadDump in trace_report and a finding in podtrace; header identity
+    drift (out-of-range process_index, inconsistent process_count,
+    duplicate labels) is flagged;
+(d) attribution rode along: the REAL streaming loader files pass/chunk
+    ingest events whose tokenizer/bin/H2D percentages telescope to
+    100%, and the serving front files queue-depth-at-enqueue plus
+    per-bucket dispatch counters into the same ring;
+(e) one rule: the post-mortem skew verdict over ring rows equals a live
+    StragglerTracker fed the same totals;
+(f) the file barrier's blocked windows honestly bound the participants'
+    exit-stamp spread, and the seam roofline joins measured spans
+    against the byte model (unmodeled seams flagged);
+(g) perf_gate treats alignment/parity/check violations as ABSOLUTE
+    findings and gates merge overhead must-not-grow; the config knob
+    rejects junk loudly.
+"""
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import elastic, podtrace, telemetry, tracing
+from lightgbm_tpu.config import OverallConfig
+from lightgbm_tpu.io.dataset import Dataset
+from lightgbm_tpu.serving import ServingEngine, ServingFront
+from lightgbm_tpu.utils.log import LightGBMError
+from scripts import perf_gate, trace_report
+
+BASE_T = 1_700_000_000.0  # synthetic wall-clock origin for sync stamps
+
+
+@pytest.fixture()
+def clean_tracing():
+    """Recorder disarmed + identity cleared around each test."""
+    tracing.disarm()
+    tracing.set_identity(process_index=None, process_count=None,
+                         run_id="")
+    yield
+    tracing.disarm()
+    tracing.set_identity(process_index=None, process_count=None,
+                         run_id="")
+
+
+def _make_dump(tmp_path, name, index, fill, count=2, run_id="run-a"):
+    """One REAL per-host dump: arm, set identity, run ``fill()``, dump,
+    disarm, reload through podtrace.load_dump."""
+    tracing.arm(ring_events=4096)
+    tracing.set_identity(process_index=index, process_count=count,
+                         run_id=run_id)
+    fill()
+    path = str(tmp_path / name)
+    assert tracing.dump(path=path, reason="test") == path
+    tracing.disarm()
+    return podtrace.load_dump(path)
+
+
+def _sync_fill(index, skew_s=0.0, iters=3, dur_s=0.010, jitter_s=0.001):
+    """Pod-wide collectives at iters 1..n: every host exits the true
+    collective at (nearly) the same true instant; a skewed host's clock
+    reads truth + skew_s."""
+    def fill():
+        for k in range(1, iters + 1):
+            t1 = BASE_T + k + skew_s + (jitter_s if index else 0.0)
+            tracing.record_collective_sync("pod/barrier", k,
+                                           t1 - dur_s, t1, pod=True)
+            tracing.observe("train_iter_us", 1000.0 * (index + k))
+            tracing.event("mark", host_tag=index, k=k)
+    return fill
+
+
+# ===================================== (a) merge algebra
+
+
+def test_merge_timeline_order_independent_and_conserving(
+        clean_tracing, tmp_path):
+    dumps = [
+        _make_dump(tmp_path, "d%d.jsonl" % i, i, _sync_fill(i), count=3)
+        for i in range(3)]
+    ref = podtrace.merge_timeline(dumps)
+    for order in ((2, 0, 1), (1, 2, 0), (2, 1, 0)):
+        again = podtrace.merge_timeline([dumps[i] for i in order])
+        assert again == ref
+    assert len(ref) == sum(len(d["events"]) for d in dumps)
+    assert {e["_host"] for e in ref} == {"p0", "p1", "p2"}
+
+
+def test_merge_sketches_order_independent_and_associative(
+        clean_tracing, tmp_path):
+    dumps = [
+        _make_dump(tmp_path, "d%d.jsonl" % i, i, _sync_fill(i), count=3)
+        for i in range(3)]
+    ref = podtrace.merge_sketches(dumps)
+    assert podtrace.merge_sketches(dumps[::-1]) == ref
+    sks = [d["header"]["sketches"]["train_iter_us"] for d in dumps]
+    left = podtrace.merge_sketch_dicts(
+        podtrace.merge_sketch_dicts(sks[0], sks[1]), sks[2])
+    right = podtrace.merge_sketch_dicts(
+        sks[0], podtrace.merge_sketch_dicts(sks[1], sks[2]))
+    assert left == right == ref["train_iter_us"]
+    merged = tracing.LatencySketch.from_dict(ref["train_iter_us"])
+    assert merged.count == sum(
+        tracing.LatencySketch.from_dict(s).count for s in sks)
+
+
+def test_merge_sketch_growth_mismatch_raises(clean_tracing):
+    a = tracing.LatencySketch(growth=1.05)
+    b = tracing.LatencySketch(growth=1.5)
+    a.record(10.0)
+    b.record(10.0)
+    with pytest.raises(podtrace.PodTraceError):
+        podtrace.merge_sketch_dicts(a.to_dict(), b.to_dict())
+
+
+# ===================================== (b) clock alignment
+
+
+def test_alignment_offset_within_recorded_bound(clean_tracing, tmp_path):
+    """Ground truth: host p1's clock is 1.5s ahead.  The estimate must
+    recover -1.5s to within the recorded collective-duration bound."""
+    skew = 1.5
+    d0 = _make_dump(tmp_path, "a.jsonl", 0, _sync_fill(0))
+    d1 = _make_dump(tmp_path, "b.jsonl", 1, _sync_fill(1, skew_s=skew))
+    al = podtrace.align([d0, d1])
+    assert al["reference"] == "p0" and al["ok"], al
+    off = al["offsets"]["p1"]
+    assert off["consistent"] and off["sync_points"] == 3
+    assert abs(off["offset_s"] - (-skew)) <= off["bound_s"] + 1e-9, off
+    # merged timeline lands p1's marks back on the reference clock
+    merged = podtrace.merge_timeline([d0, d1], al)
+    assert len(merged) == len(d0["events"]) + len(d1["events"])
+
+
+def test_process_local_collectives_are_not_sync_points(
+        clean_tracing, tmp_path):
+    def local_fill():
+        for k in range(1, 4):
+            t1 = BASE_T + k
+            tracing.record_collective_sync("elastic/times_allgather", k,
+                                           t1 - 0.01, t1, pod=False)
+    d0 = _make_dump(tmp_path, "a.jsonl", 0, local_fill)
+    d1 = _make_dump(tmp_path, "b.jsonl", 1, local_fill)
+    al = podtrace.align([d0, d1])
+    assert not al["ok"]
+    assert al["offsets"]["p1"]["offset_s"] is None
+    assert any("cannot be aligned" in f
+               for f in podtrace.check([d0, d1], al))
+
+
+# ===================================== (c) tampering / bookkeeping
+
+
+def _serve_fill():
+    comps = {"queue": 10, "linger": 5, "coalesce": 0, "dispatch": 7,
+             "walk": 40, "scatter": 3}
+    tracing.event("serve_complete", trace=1, wall_ns=sum(comps.values()),
+                  components_ns=comps)
+
+
+def test_tampered_attribution_caught_in_merge(clean_tracing, tmp_path):
+    d0 = _make_dump(tmp_path, "a.jsonl",
+                    0, lambda: (_sync_fill(0)(), _serve_fill()))
+    d1 = _make_dump(tmp_path, "b.jsonl",
+                    1, lambda: (_sync_fill(1)(), _serve_fill()))
+    assert podtrace.check([d0, d1]) == []
+    # tamper host p1's dump on disk: inflate one component
+    lines = open(d1["path"]).read().splitlines()
+    out = []
+    for line in lines:
+        rec = json.loads(line)
+        if rec.get("kind") == "serve_complete":
+            rec["components_ns"]["walk"] += 1
+        out.append(json.dumps(rec))
+    with open(d1["path"], "w") as f:
+        f.write("\n".join(out) + "\n")
+    bad = podtrace.check([d0, podtrace.load_dump(d1["path"])])
+    assert any("attribution identity broken" in b for b in bad), bad
+
+
+def test_run_mix_is_loud(clean_tracing, tmp_path):
+    d0 = _make_dump(tmp_path, "a.jsonl", 0, _sync_fill(0),
+                    run_id="run-a")
+    d1 = _make_dump(tmp_path, "b.jsonl", 1, _sync_fill(1),
+                    run_id="run-b")
+    assert any("run" in f and "mix" in f
+               for f in podtrace.check_headers([d0, d1]))
+    loaded = [(d["path"], trace_report.load(d["path"])[0])
+              for d in (d0, d1)]
+    mix = trace_report.check_run_mix(loaded)
+    assert mix and "run-a" in mix and "run-b" in mix
+
+
+def test_header_identity_validation(clean_tracing, tmp_path):
+    # out-of-range process_index caught by BOTH checkers
+    d = _make_dump(tmp_path, "a.jsonl", 5, _sync_fill(0), count=2)
+    header, events = trace_report.load(d["path"])
+    assert any("process_index" in f
+               for f in trace_report.check(d["path"], header, events))
+    assert any("process_index" in f for f in podtrace.check_headers([d]))
+    # duplicate labels (same identity twice) flagged
+    d0 = _make_dump(tmp_path, "b.jsonl", 0, _sync_fill(0))
+    d0b = _make_dump(tmp_path, "c.jsonl", 0, _sync_fill(0))
+    assert any("label" in f or "duplicate" in f
+               for f in podtrace.check_headers([d0, d0b]))
+
+
+# ===================================== (d) ingest + serving attribution
+
+
+def test_streaming_ingest_attribution_in_ring(clean_tracing, tmp_path):
+    rng = np.random.RandomState(7)
+    x = rng.randn(400, 5)
+    y = (x[:, 0] > 0).astype(np.float64)
+    csv = str(tmp_path / "ingest.csv")
+    np.savetxt(csv, np.column_stack([y, x]), fmt="%.6g", delimiter=",")
+
+    def fill():
+        cfg = OverallConfig()
+        cfg.set({"objective": "binary", "data": csv,
+                 "streaming": "true"})
+        Dataset.load_train(cfg.io_config)
+    d = _make_dump(tmp_path, "d.jsonl", 0, fill, count=1)
+    passes = [e for e in d["events"] if e["kind"] == "ingest_pass"]
+    chunks = [e for e in d["events"] if e["kind"] == "ingest_chunk"]
+    assert {int(e["pass"]) for e in passes} == {0, 1, 2}
+    assert chunks and all(int(e["rows"]) > 0 for e in chunks)
+    bd = podtrace.ingest_breakdown([d])["p0"]
+    assert bd["rows"] == 400
+    pcts = [v for v in bd["pcts"].values() if v is not None]
+    assert pcts and abs(sum(pcts) - 100.0) < 0.5, bd["pcts"]
+
+
+def test_serve_enqueue_depth_and_dispatch_counters(
+        clean_tracing, tmp_path):
+    rng = np.random.RandomState(3)
+    x = rng.randn(256, 6)
+    y = (x[:, 0] > 0).astype(np.float32)
+    ds = Dataset.from_arrays(x, y, max_bin=32)
+    booster = lgb.train({"objective": "binary", "num_leaves": 8,
+                         "min_data_in_leaf": 10,
+                         "min_sum_hessian_in_leaf": 1.0,
+                         "num_iterations": 2}, ds)
+
+    def fill():
+        front = ServingFront(ServingEngine(booster.export_flat()),
+                             linger_us=1000)
+        try:
+            futs = [front.submit(x[i * 16:(i + 1) * 16])
+                    for i in range(8)]
+            for f in futs:
+                f.result(30)
+        finally:
+            front.close()
+    d = _make_dump(tmp_path, "d.jsonl", 0, fill, count=1)
+    enq = [e for e in d["events"] if e["kind"] == "serve_enqueue"]
+    assert len(enq) == 8
+    assert all(isinstance(e.get("depth_rows"), int)
+               and e["depth_rows"] >= 0 for e in enq)
+    # the first request entered an empty queue
+    assert min(e["depth_rows"] for e in enq) == 0
+    counters = d["header"]["counters"]
+    buckets = {k: v for k, v in counters.items()
+               if k.startswith("serve/dispatch_bucket_")}
+    assert buckets and sum(buckets.values()) >= 1, counters
+    rows = sum(v for k, v in counters.items()
+               if k.startswith("serve/dispatch_rows_bucket_"))
+    assert rows == 8 * 16, counters
+
+
+# ===================================== (e) one skew rule
+
+
+def test_postmortem_skew_equals_live_tracker(clean_tracing, tmp_path):
+    def iter_fill(index):
+        def fill():
+            for k in range(1, 5):
+                pt = {ph: 0.010 * (1 + 2 * index)
+                      for ph in elastic.CANONICAL_PHASES}
+                tracing.record_train_iteration(k, pt)
+        return fill
+    dumps = [_make_dump(tmp_path, "d%d.jsonl" % i, i, iter_fill(i))
+             for i in range(2)]
+    rows = podtrace.skew_rows(dumps)
+    post = elastic.skew_from_rows(rows, straggler_k=3)
+    live = elastic.StragglerTracker(3)
+    for k in sorted(rows):
+        totals = {h: sum(pt.values()) for h, pt in rows[k].items()}
+        live.update(k, elastic.slowest_unique(totals))
+    assert live.flagged == "p1"
+    assert post["persistent_straggler"] == live.flagged
+
+
+# ===================================== (f) barrier + roofline
+
+
+def test_file_barrier_bound_covers_exit_spread(tmp_path):
+    res = {}
+
+    def worker(i):
+        res[i] = podtrace.file_barrier(str(tmp_path), "it", i, 2,
+                                       payload={"v": i}, timeout=30.0)
+
+    t = threading.Thread(target=worker, args=(1,))
+    t.start()
+    time.sleep(0.05)  # participant 0 arrives late: real exit skew
+    worker(0)
+    t.join(30)
+    (p0, a0, b0), (p1, a1, b1) = res[0], res[1]
+    assert p0 == p1 == {0: {"v": 0}, 1: {"v": 1}}
+    assert abs(b0 - b1) <= max(b0 - a0, b1 - a1) + 1e-9
+    with pytest.raises(TimeoutError):
+        podtrace.file_barrier(str(tmp_path), "alone", 0, 2,
+                              timeout=0.2)
+
+
+def test_seam_roofline_joins_spans_and_flags_drift(
+        clean_tracing, tmp_path):
+    def fill():
+        tracing.record_collective_sync("hist/psum", 1,
+                                       BASE_T, BASE_T + 0.5, pod=True)
+        tracing.record_collective_sync("hist/psum", 2,
+                                       BASE_T + 1, BASE_T + 1.5,
+                                       pod=True)
+        tracing.record_collective_sync("orphan/seam", 1,
+                                       BASE_T, BASE_T + 0.1, pod=False)
+        tracing.event("wire_model", sites={
+            "hist/psum": {"est_bytes": 2_000_000,
+                          "bytes_per_call": 1_000_000, "est_calls": 2,
+                          "kind": "psum"},
+            "unmeasured/seam": {"est_bytes": 7}})
+    d = _make_dump(tmp_path, "d.jsonl", 0, fill, count=1)
+    roof = podtrace.seam_roofline(
+        [d], peaks={"ici_bytes_per_sec": 8_000_000.0})
+    row = roof["sites"]["hist/psum"]
+    # 1 MB/call x 2 calls over 1.0s blocked -> 2 MB/s, 1/4 of the peak
+    assert row["modeled"] and row["calls"] == 2
+    assert abs(row["span_s"] - 1.0) < 1e-6
+    assert abs(row["attained_gb_per_s"] - 0.002) < 1e-9
+    assert abs(row["frac_of_ici_peak"] - 0.25) < 1e-9
+    assert roof["unmodeled"] == ["orphan/seam"]
+    # an unmeasured-but-modeled site stays in the table (coverage)
+    assert roof["sites"]["unmeasured/seam"]["span_s"] is None
+    # off-TPU: no peak -> fraction honestly None
+    roof_cpu = podtrace.seam_roofline([d], peaks=None)
+    assert roof_cpu["sites"]["hist/psum"]["frac_of_ici_peak"] is None
+
+
+# ===================================== (g) gate lanes + knob
+
+
+def _gate_entries(*pods):
+    return [{"kind": "multichip", "round": r, "path": "m%d" % r,
+             "rec": {"ok": True, "n_devices": 8, "podtrace": pt}}
+            for r, pt in enumerate(pods, 1)]
+
+
+def test_perf_gate_podtrace_absolute_findings():
+    good = {"alignment_ok": True, "check_findings": 0, "unmodeled": 0,
+            "parity": True, "merge_ms_per_kevent": 2.0}
+    findings = []
+    perf_gate._check_podtrace(_gate_entries(good), findings)
+    assert findings == []
+    for key, bad in (("alignment_ok", False), ("check_findings", 3),
+                     ("unmodeled", 1), ("parity", False)):
+        findings = []
+        perf_gate._check_podtrace(
+            _gate_entries(dict(good, **{key: bad})), findings)
+        assert [f["key"] for f in findings] == ["podtrace/" + key]
+
+
+def test_perf_gate_podtrace_merge_overhead_must_not_grow():
+    good = {"alignment_ok": True, "check_findings": 0, "unmodeled": 0,
+            "parity": True}
+    hist = [dict(good, merge_ms_per_kevent=v) for v in (2.0, 2.2, 2.1)]
+    findings = []
+    perf_gate._check_podtrace(_gate_entries(*hist), findings)
+    assert findings == []
+    findings = []
+    perf_gate._check_podtrace(
+        _gate_entries(*hist, dict(good, merge_ms_per_kevent=40.0)),
+        findings)
+    assert [f["key"] for f in findings] == \
+        ["podtrace/merge_ms_per_kevent"]
+
+
+def test_perf_gate_parses_podtrace_from_tail():
+    rec = {"ok": True, "n_devices": 8,
+           "tail": "x\nMULTICHIP_PODTRACE " + json.dumps(
+               {"alignment_ok": True, "parity": True}) + "\n"}
+    perf_gate._attach_multichip_obs(rec)
+    assert rec["podtrace"]["parity"] is True
+
+
+def test_trace_run_id_knob_rejects_junk():
+    for bad in ("has space", "x" * 129, "tab\tchar"):
+        cfg = OverallConfig()
+        with pytest.raises(LightGBMError):
+            cfg.set({"objective": "binary", "trace_run_id": bad},
+                    require_data=False)
