@@ -19,28 +19,37 @@ import os
 
 __version__ = "0.1.0"
 
+# Exec'd parallel-parse workers (io/parallel_ingest.py) import this
+# package but touch only the numpy parse stack: skip the JAX surface so
+# worker startup is milliseconds, not a backend import.
+_INGEST_WORKER = os.environ.get("LIGHTGBM_TPU_INGEST_WORKER") == "1"
+
 # Persistent XLA compilation cache: the unrolled tree-grower programs take
 # minutes to compile; caching makes every process after the first start hot.
 # TPU-only — CPU AOT artifacts are host-feature-specific and a cache shared
 # across heterogeneous hosts can SIGILL.
-try:  # pragma: no cover - environment dependent
-    import jax
+if not _INGEST_WORKER:
+    try:  # pragma: no cover - environment dependent
+        import jax
 
-    if (jax.config.jax_compilation_cache_dir is None
-            and "cpu" not in os.environ.get("JAX_PLATFORMS", "").lower()):
-        jax.config.update(
-            "jax_compilation_cache_dir",
-            os.environ.get("LIGHTGBM_TPU_CACHE",
-                           os.path.expanduser("~/.cache/lightgbm_tpu_xla")))
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-except Exception:
-    pass
+        if (jax.config.jax_compilation_cache_dir is None
+                and "cpu" not in os.environ.get("JAX_PLATFORMS",
+                                                "").lower()):
+            jax.config.update(
+                "jax_compilation_cache_dir",
+                os.environ.get(
+                    "LIGHTGBM_TPU_CACHE",
+                    os.path.expanduser("~/.cache/lightgbm_tpu_xla")))
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
 
-from . import telemetry
-from .config import OverallConfig, load_config
-from .io.dataset import Dataset
-from .models.gbdt import GBDT
-from .models.tree import Tree
+    from . import telemetry
+    from .config import OverallConfig, load_config
+    from .io.dataset import Dataset
+    from .models.gbdt import GBDT
+    from .models.tree import Tree
 
 
 def train(params: dict, train_set: Dataset, valid_sets=(), valid_names=None):

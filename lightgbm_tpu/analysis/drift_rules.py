@@ -208,6 +208,10 @@ D2_INFORMATIONAL = {
         "ours — vs_cuda gates the ratio",
     "ingest_sync_rows_per_sec":
         "depth-0 A/B reference of the gated ingest_rows_per_sec lane",
+    "ingest_serial_rows_per_sec":
+        "same-record serial reference lane of the parallel-parse "
+        "must-GROW check (ISSUE 18) — perf_gate consumes it as the "
+        "workers-lane baseline, not as its own trend series",
     "predict_scan_b65536_rows_per_sec":
         "legacy per-tree-replay A/B reference the bfs-vs-scan ratio "
         "prices; the BFS lanes are gated",

@@ -152,6 +152,7 @@ KNOB_INVENTORY = {
     "save_binary_format": "native or reference cache layout",
     "streaming": "auto/true/false chunked parse→bin→HBM loader",
     "ingest_chunk_rows": "streaming chunk length (host-resident row bound)",
+    "ingest_workers": "byte-range parse worker processes (auto = cpu_count)",
     "output_model": "trained model output path",
     "input_model": "model to continue training from / predict with",
     "input_init_score": "initial-score side file",

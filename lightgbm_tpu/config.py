@@ -246,6 +246,11 @@ class IOConfig:
     # parse/bin/transfer chunk length (rows) of the streaming loader —
     # also the bound on how many raw rows are ever host-resident
     ingest_chunk_rows: int = 200_000
+    # parse worker processes of the streaming loader (ISSUE 18,
+    # io/parallel_ingest.py): > 1 fans tokenize+bin out over byte-range
+    # workers (bit-identical datasets); "auto" = cpu_count; 1 (default)
+    # keeps the serial passes
+    ingest_workers: int = 1
     use_two_round_loading: bool = False
     is_save_binary_file: bool = False
     # format of the is_save_binary_file cache: "native" (pickle header +
@@ -399,6 +404,13 @@ class IOConfig:
                                           self.ingest_chunk_rows)
         log.check(self.ingest_chunk_rows > 0,
                   "ingest_chunk_rows should be > 0")
+        if str(params.get("ingest_workers", "")).lower() == "auto":
+            self.ingest_workers = os.cpu_count() or 1
+        else:
+            self.ingest_workers = _get_int(params, "ingest_workers",
+                                           self.ingest_workers)
+        log.check(self.ingest_workers > 0,
+                  "ingest_workers should be > 0 (or auto = cpu_count)")
         self.use_two_round_loading = _get_bool(params, "use_two_round_loading",
                                                self.use_two_round_loading)
         self.is_save_binary_file = _get_bool(params, "is_save_binary_file",

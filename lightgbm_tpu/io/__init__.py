@@ -1,4 +1,9 @@
 """Host-side data layer: parsers, binning, Dataset, Metadata."""
-from .dataset import Dataset
-from .binning import BinMapper
-from .metadata import Metadata
+import os as _os
+
+if _os.environ.get("LIGHTGBM_TPU_INGEST_WORKER") != "1":
+    # exec'd parallel-parse workers (parallel_ingest.py) skip the
+    # Dataset import — it pulls the whole JAX model stack
+    from .dataset import Dataset
+    from .binning import BinMapper
+    from .metadata import Metadata

@@ -138,6 +138,21 @@ class BinMapper:
         (serial_tree_learner.cpp:418 BinToValue)."""
         return float(self.bin_upper_bound[bin_idx])
 
+    def bin_representatives(self) -> np.ndarray:
+        """One finite real value per bin that ``value_to_bin`` maps back
+        to that bin — the decode table for predicting straight from a
+        columnar-binary cache (predictor.predict_file on a ``.bin``
+        input).  Bin b < num_bin-1 uses its own upper bound: bounds are
+        strictly increasing and the searchsorted is side="left", so
+        ``value_to_bin(upper[b]) == b`` exactly.  The last bin's bound is
+        +inf — any value strictly above the previous bound lands there,
+        so ``upper[-2] + 1`` does (single-bin mappers are trivial; 0.0
+        keeps them finite)."""
+        vals = self.bin_upper_bound.astype(np.float64).copy()
+        if vals.size and not np.isfinite(vals[-1]):
+            vals[-1] = vals[-2] + 1.0 if vals.size > 1 else 0.0
+        return vals
+
     @property
     def default_bin(self) -> int:
         """Bin of value 0 — the implicit bin for unseen entries

@@ -106,6 +106,37 @@ class Dataset:
         self.data_filename = io_config.data_filename
         self.max_bin = io_config.max_bin
 
+        # direct columnar-binary input (ISSUE 18b): ``data=`` itself IS a
+        # native cache — header-sniffed via BINARY_MAGIC, so repeat jobs
+        # skip text entirely (no text sibling required).  A text file
+        # classifies "foreign" here and falls through to the normal
+        # loaders untouched.
+        if os.path.exists(io_config.data_filename):
+            kind = self._classify_binary_cache(io_config.data_filename)
+            if kind == "ours":
+                direct = io_config.data_filename
+                if (num_machines <= 1 and streaming.single_process()
+                        and streaming.resolve_streaming(io_config,
+                                                        direct)):
+                    log.info("Loading data set from binary file "
+                             "(streamed, direct)")
+                    streaming.load_binary_streaming(
+                        self, direct, io_config, shard_rows=shard_rows,
+                        shard_devices=shard_devices,
+                        device_type=device_type)
+                else:
+                    log.info("Loading data set from binary file (direct)")
+                    self._load_binary(direct, rank, num_machines,
+                                      io_config.is_pre_partition,
+                                      io_config.data_random_seed)
+                self._attach_init_score(io_config.input_init_score,
+                                        predict_fun)
+                return self
+            if kind == "corrupt":
+                log.fatal("Binary file %s is a corrupt/truncated "
+                          "lightgbm_tpu cache — delete it to regenerate"
+                          % io_config.data_filename)
+
         bin_path = io_config.data_filename + ".bin"
         foreign_bin = False
         if os.path.exists(bin_path):

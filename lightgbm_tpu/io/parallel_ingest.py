@@ -1,0 +1,668 @@
+"""Process-parallel byte-range ingest (ISSUE 18).
+
+The streaming loader (io/streaming.load_train_streaming) tokenizes the
+whole text file twice on one core — and PR 17's per-chunk attribution
+proved that tokenizer IS the declining ingest_rows_per_sec wall
+(ingest_sync ≈ ingest: the device pipeline hides nothing).  This module
+is the reference's pipeline_reader.h generalized to worker PROCESSES
+over disjoint byte ranges (parser.split_byte_ranges snaps split points
+to row starts, so no two workers ever read the same bytes), with two
+structural savings on top of core-parallelism:
+
+- **Pass 0 is folded into the split scan**: one raw byte scan yields the
+  snapped ranges AND the per-range/total row counts, so the file is read
+  twice per load, not three times.
+- **Pass 1 is selective**: only the label / in-file weight / in-file
+  group columns are extracted for every row (a positional token split +
+  the exact ``_atof`` semantics both full-parse tiers reduce to), and
+  the full tokenizer runs ONLY over the ≤SAMPLE_CNT pinned sample rows.
+  The serial loader full-parses every row twice; this path full-parses
+  every row once — the dominant term of the measured speedup on hosts
+  where cores don't help (bench lane: PROFILE.md's ingest cost model).
+
+Distributed loads (num_machines > 1) add the pod-scale cut: pass 2
+parses ONLY the rows of this host's shard (the mask is drawn up front —
+it depends only on the seed, the row count and the SIDE-file query
+boundaries, all known before pass 1), where the serial path tokenizes
+the full file on every host and masks after parse.  Pass 1 stays
+full-file on purpose: labels/weights/groups enter metadata full-length
+before ``partition`` (the serial order of operations), and the binning
+sample is global.
+
+Bit-identity with the serial loader is the correctness bar and is
+test-pinned end to end (tests/test_parallel_ingest.py): same mappers,
+same bin matrix bytes, same streamed cache bytes, same metadata, same
+trained model text — at any worker count, including the sharded
+multi-process path.  Everything order-sensitive is assembled in the
+parent in range order; the pinned-sample reservoir is filled per GLOBAL
+row id, so each range writes only its slice of the draw.
+
+Workers are exec'd processes (``python -m lightgbm_tpu.io.parallel_ingest``),
+NOT forks: forking the training process deadlocks once the XLA
+backend's threads are live (the forked child inherits locked mutexes no
+surviving thread will ever release — reproduced mid-suite in tier-1),
+and every ``multiprocessing`` start method either forks the parent or
+re-imports ``__main__`` in the child (the spawn/forkserver preparation
+step — wrong and slow for a ``bench.py``/stdin parent).  So the pool
+execs clean interpreters that import ONLY the numpy parse stack (the
+package ``__init__`` skips its JAX surface under
+``LIGHTGBM_TPU_INGEST_WORKER=1``; startup is milliseconds) and speaks
+length-free pickle frames over stdin/stdout.  Workers PERSIST across
+passes and loads (module-global pool, atexit-reaped) so repeat loads
+pay zero spawn cost; per-pass job state (parser, ranges, mappers) is
+re-broadcast into each worker's ``_JOB`` before its tasks.  Workers
+return measured parse/bin times; the parent files the ``ingest/*``
+counters and
+``record_ingest_chunk`` events (with the worker id, so per-worker parse
+spans land in the flight-recorder ring and pod_report attribution keeps
+working), plus ``ingest/worker_wait_us`` — the parent's time actually
+blocked on worker results, the residual tokenizer wall that shrinks as
+workers scale.
+"""
+from __future__ import annotations
+
+import collections
+import os
+import sys
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from .. import telemetry, tracing
+from ..utils import log
+from . import parser as parser_mod
+from .parser import ZERO_THRESHOLD, _atof, _DelimitedParser
+
+# in-flight task window per pool: enough to keep every worker busy while
+# the parent drains results in range order, small enough that buffered
+# results (one range's sample/bin payload each) stay bounded
+_WINDOW_EXTRA = 2
+
+_JOB = None      # per-pass worker state; broadcast before each pass
+_WORKERS: List["_Worker"] = []  # persistent exec'd pool, atexit-reaped
+_REAPER_ARMED = False
+
+WORKER_ENV = "LIGHTGBM_TPU_INGEST_WORKER"
+
+
+def available() -> bool:
+    """Parallel parse execs fresh interpreters (never forks the
+    JAX-threaded trainer), so it only needs a launchable
+    ``sys.executable``."""
+    try:
+        return bool(sys.executable) and os.path.exists(sys.executable)
+    except Exception:
+        return False
+
+
+class _Job:
+    """Per-pass worker state, broadcast to each worker as one pickle."""
+
+    def __init__(self, **kw):
+        self.__dict__.update(kw)
+
+
+class _InlineResult:
+    def __init__(self, fn, args):
+        self._fn, self._args = fn, args
+
+    def get(self):
+        return self._fn(*self._args)
+
+
+class _InlinePool:
+    """``workers == 1`` — the pod-sharded parse with no parallelism
+    requested: run the range jobs in-process through the same code
+    path, skipping the worker spawn cost every multi-process load would
+    otherwise pay per pass."""
+
+    def apply_async(self, fn, args):
+        return _InlineResult(fn, args)
+
+    def terminate(self):
+        pass
+
+    def join(self):
+        pass
+
+
+class _Worker:
+    """One exec'd worker: pickle frames over stdin/stdout (pickle is
+    self-delimiting, so no length prefix); stderr passes through."""
+
+    def __init__(self):
+        import pickle
+        import subprocess
+        env = dict(os.environ)
+        env[WORKER_ENV] = "1"
+        # the worker resolves this package by import, wherever the
+        # parent loaded it from
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env["PYTHONPATH"] = (pkg_root + os.pathsep
+                             + env.get("PYTHONPATH", "")).rstrip(os.pathsep)
+        self._pickle = pickle
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "lightgbm_tpu.io.parallel_ingest"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, env=env)
+        # a 64KB default pipe stalls the worker mid-result while the
+        # parent is committing an earlier range; at the kernel cap a
+        # whole binned-range payload fits, so workers parse ahead
+        # instead of blocking (the overlap the fork-Pool's reader
+        # thread used to provide)
+        try:
+            import fcntl
+            fcntl.fcntl(self.proc.stdout.fileno(),
+                        getattr(fcntl, "F_SETPIPE_SZ", 1031), 1 << 20)
+        except Exception:
+            pass
+
+    def send(self, msg) -> None:
+        self._pickle.dump(msg, self.proc.stdin,
+                          protocol=self._pickle.HIGHEST_PROTOCOL)
+        self.proc.stdin.flush()
+
+    def recv(self):
+        try:
+            kind, payload = self._pickle.load(self.proc.stdout)
+        except EOFError:
+            raise RuntimeError(
+                "parallel ingest worker (pid %s) exited mid-task"
+                % self.proc.pid)
+        if kind == "err":
+            raise RuntimeError(
+                "parallel ingest worker task failed:\n%s" % payload)
+        return payload
+
+    def close(self) -> None:
+        try:
+            self.send(("exit",))
+            self.proc.stdin.close()
+        except Exception:
+            pass
+        try:
+            self.proc.wait(timeout=5)
+        except Exception:
+            self.proc.kill()
+            self.proc.wait()
+
+
+def shutdown_workers() -> None:
+    """Reap the persistent pool (atexit; also the desync escape: a load
+    that died mid-pass may leave queued tasks, so the broken workers are
+    dropped and the next load respawns clean ones)."""
+    global _WORKERS
+    workers, _WORKERS = _WORKERS, []
+    for w in workers:
+        w.close()
+
+
+class _SubprocPool:
+    """apply_async/terminate/join shim over the persistent workers.
+
+    Tasks are dealt round-robin; each worker answers its own stdin
+    queue in FIFO order, so reading results in submission order per
+    worker keeps the parent's range-ordered drain exact."""
+
+    def __init__(self, workers: int, job):
+        global _REAPER_ARMED
+        _WORKERS[:] = [w for w in _WORKERS if w.proc.poll() is None]
+        while len(_WORKERS) < workers:
+            _WORKERS.append(_Worker())
+        if not _REAPER_ARMED:
+            import atexit
+            atexit.register(shutdown_workers)
+            _REAPER_ARMED = True
+        self.ws = _WORKERS[:workers]
+        self.rr = 0
+        self.outstanding = 0
+        for w in self.ws:
+            w.send(("job", job))
+
+    def apply_async(self, fn, args):
+        w = self.ws[self.rr % len(self.ws)]
+        self.rr += 1
+        w.send(("task", fn.__name__, args[0]))
+        self.outstanding += 1
+        return _PoolResult(self, w)
+
+    def terminate(self):
+        if self.outstanding:
+            shutdown_workers()
+
+    def join(self):
+        pass
+
+
+class _PoolResult:
+    def __init__(self, pool: _SubprocPool, worker: _Worker):
+        self._pool, self._worker = pool, worker
+
+    def get(self):
+        res = self._worker.recv()
+        self._pool.outstanding -= 1
+        return res
+
+
+def _pool(workers: int, job):
+    global _JOB
+    _JOB = job
+    if int(workers) <= 1:
+        return _InlinePool()
+    return _SubprocPool(int(workers), job)
+
+
+def _bounded_imap(pool, fn, n_tasks: int, window: int):
+    """Ordered results with at most ``window`` tasks in flight — the
+    backpressure Pool.imap lacks (its result cache would otherwise
+    buffer every completed range while the parent is mid-commit)."""
+    pending: "collections.deque" = collections.deque()
+    nxt = 0
+    while nxt < min(window, n_tasks):
+        pending.append(pool.apply_async(fn, (nxt,)))
+        nxt += 1
+    while pending:
+        t0 = time.perf_counter()
+        res = pending.popleft().get()
+        telemetry.count("ingest/worker_wait_us",
+                        int((time.perf_counter() - t0) * 1e6))
+        if nxt < n_tasks:
+            pending.append(pool.apply_async(fn, (nxt,)))
+            nxt += 1
+        yield res
+
+
+def plan_ranges(filename: str, skip_header: bool, workers: int,
+                chunk_rows: int):
+    """Choose and snap the byte ranges (the fused pass-0 scan).
+
+    Ranges are byte-balanced at ~4 tasks per worker (clamped to
+    [1MB, 32MB] targets), then re-split until no range exceeds
+    ``ingest_chunk_rows`` rows — the streaming tier's host-residency
+    bound applies per worker payload exactly as it does per serial
+    chunk."""
+    size = os.path.getsize(filename)
+    d0 = parser_mod.data_byte_start(filename, skip_header)
+    data_bytes = max(size - d0, 1)
+    target = min(max(data_bytes // max(workers * 4, 1), 1 << 20), 32 << 20)
+    k = max(workers, -(-data_bytes // target))
+    ranges, counts, total = parser_mod.split_byte_ranges(
+        filename, k, skip_header=skip_header)
+    for _ in range(8):
+        if not any(c > chunk_rows for c in counts):
+            break
+        cands = []
+        for (s, e), c in zip(ranges, counts):
+            cands.append(s)
+            if c > chunk_rows:
+                parts = -(-c // chunk_rows)
+                cands.extend(s + ((e - s) * i) // parts
+                             for i in range(1, parts))
+        ranges, counts, total = parser_mod.split_byte_ranges_at(
+            filename, cands[1:], skip_header=skip_header)
+    return ranges, counts, total
+
+
+# ------------------------------------------------------------ pass 1
+
+
+def _extract_column(lines, delim: str, raw_idx: int) -> np.ndarray:
+    """One raw column as float64 via the exact-tier token semantics
+    (``_atof``): bit-identical to slicing the full-parse matrix —
+    round_trip IS float(), and both tiers map na/garbage tokens to 0."""
+    if raw_idx == 0:
+        toks = [ln.split(delim, 1)[0] for ln in lines]
+    else:
+        n = raw_idx + 1
+        toks = [ln.split(delim, n)[raw_idx] for ln in lines]
+    return np.array([_atof(t) for t in toks], dtype=np.float64)
+
+
+def _pass1_range(ridx: int):
+    job = _JOB
+    t0 = time.perf_counter()
+    s, e = job.ranges[ridx]
+    lines = parser_mod.read_range_lines(job.filename, s, e)
+    n = len(lines)
+    g0 = job.offsets[ridx]
+    out = {"ridx": ridx, "n": n, "pid": os.getpid()}
+    local = None
+    if job.sample_idx is not None:
+        lo = np.searchsorted(job.sample_idx, g0)
+        hi = np.searchsorted(job.sample_idx, g0 + n)
+        local = job.sample_idx[lo:hi] - g0
+    delim = job.delimiter
+    selective = delim is not None and local is not None and n > 0
+    if selective:
+        n_delim = lines[0].count(delim)
+        if any(ln.count(delim) != n_delim for ln in lines):
+            # ragged range: the full parser reproduces the exact tier's
+            # format-error fatal (or its values, for short first lines)
+            selective = False
+    if selective:
+        ncols_raw = n_delim + 1
+        li = job.label_raw
+        has_label = 0 <= li < ncols_raw
+        out["num_cols"] = ncols_raw - 1 if has_label else ncols_raw
+        if has_label:
+            out["labels"] = _extract_column(lines, delim, li).astype(
+                np.float32)
+        else:
+            out["labels"] = np.zeros(n, dtype=np.float32)
+        for key, fidx in (("weight", job.weight_idx),
+                          ("group", job.group_idx)):
+            if fidx >= 0:
+                raw = fidx + (1 if has_label and fidx >= li else 0)
+                col = _extract_column(lines, delim, raw)
+                # parse() zero-drops features AFTER label removal; the
+                # weight/group slices the serial pass 1 takes are
+                # post-threshold values
+                col[np.abs(col) <= ZERO_THRESHOLD] = 0.0
+                out[key] = (col.astype(np.float32) if key == "weight"
+                            else col)
+        if local.size:
+            out["sample"] = job.parser.parse(
+                [lines[i] for i in local]).features
+    else:
+        parsed = job.parser.parse(lines)
+        feats = parsed.features
+        out["num_cols"] = feats.shape[1]
+        out["labels"] = parsed.labels
+        if job.weight_idx >= 0:
+            out["weight"] = feats[:, job.weight_idx].astype(np.float32)
+        if job.group_idx >= 0:
+            out["group"] = feats[:, job.group_idx].copy()
+        if local is None:
+            out["sample"] = feats
+        elif local.size:
+            out["sample"] = feats[local]
+    out["parse_us"] = (time.perf_counter() - t0) * 1e6
+    return out
+
+
+# ------------------------------------------------------------ pass 2
+
+
+def _pass2_range(ridx: int):
+    job = _JOB
+    t0 = time.perf_counter()
+    s, e = job.ranges[ridx]
+    lines = parser_mod.read_range_lines(job.filename, s, e)
+    c0 = len(lines)
+    sel = job.sel_local[ridx] if job.sel_local is not None else None
+    if sel is not None:
+        lines = [lines[i] for i in sel]
+    if lines:
+        feats = job.parser.parse(lines).features
+    else:
+        feats = np.zeros((0, job.num_cols), dtype=np.float64)
+    t1 = time.perf_counter()
+    n = feats.shape[0]
+    binned = np.empty((len(job.mappers), n), dtype=job.dtype)
+    for j_raw, j_inner in job.used_feature_map.items():
+        binned[j_inner] = job.mappers[j_inner].value_to_bin(
+            feats[:, j_raw]).astype(job.dtype)
+    t2 = time.perf_counter()
+    return (ridx, c0, n, binned, feats if job.need_feats else None,
+            (t1 - t0) * 1e6, (t2 - t1) * 1e6, os.getpid())
+
+
+# ------------------------------------------------------------ the load
+
+
+def load_train_streaming_parallel(
+        ds, io_config, parser, rank: int, num_machines: int, predict_fun,
+        bin_finder, weight_idx: int, group_idx: int, ignore_set,
+        header_names, shard_rows: bool = False,
+        shard_devices: Optional[int] = None, device_type: str = "",
+        foreign_bin: bool = False, workers: int = 2) -> None:
+    """The parallel twin of ``streaming.load_train_streaming`` — same
+    passes, same metadata order of operations, same counters/events/
+    guards, with parse (and bin) fanned out over byte-range workers."""
+    from . import dataset as dataset_mod
+    from . import streaming
+
+    filename = io_config.data_filename
+    chunk_rows = getattr(io_config, "ingest_chunk_rows", 200_000)
+    device_resident = num_machines <= 1 and streaming.single_process()
+    workers = max(int(workers), 1)
+    window = workers + _WINDOW_EXTRA
+    ds.ingest_workers_effective = workers
+
+    with telemetry.span("ingest"):
+        # ---- pass 0, folded into the byte-range split: ONE raw scan
+        t_pass = time.perf_counter()
+        with telemetry.span("ingest_count"):
+            ranges, counts, total_rows = plan_ranges(
+                filename, io_config.has_header, workers, chunk_rows)
+        tracing.record_ingest_pass(0, time.perf_counter() - t_pass,
+                                   total_rows)
+        ds.global_num_data = total_rows
+        sample_idx = streaming.pinned_sample_indices(
+            total_rows, io_config.data_random_seed, dataset_mod.SAMPLE_CNT)
+        offsets = np.concatenate(
+            ([0], np.cumsum(counts))).astype(np.int64)
+        k = len(ranges)
+
+        # shard mask up front (serial draws it after pass 1): the draw
+        # reads only the seed, the row count and the SIDE-file query
+        # boundaries — none of which pass 1 touches — so the mask is
+        # bit-identical, and pass 2 can parse owned rows only
+        ds.used_data_indices = ds._draw_shard_mask(io_config, rank,
+                                                   num_machines,
+                                                   total_rows)
+
+        # ---- pass 1 (pooled): selective label/side-column scan; the
+        # full tokenizer runs only over the pinned sample rows
+        delim = (parser.delimiter
+                 if isinstance(parser, _DelimitedParser) else None)
+        job = _Job(filename=filename, ranges=ranges,
+                   offsets=offsets[:-1], parser=parser, delimiter=delim,
+                   label_raw=parser.label_idx, sample_idx=sample_idx,
+                   weight_idx=weight_idx, group_idx=group_idx)
+        labels_parts: List[np.ndarray] = []
+        weight_parts: List[np.ndarray] = []
+        group_parts: List[np.ndarray] = []
+        sample_parts: List[np.ndarray] = []
+        reservoir = None
+        num_cols = None
+        start = 0
+        t_pass = time.perf_counter()
+        with telemetry.span("ingest_pass1"):
+            pool = _pool(workers, job)
+            try:
+                for out in _bounded_imap(pool, _pass1_range, k, window):
+                    n = out["n"]
+                    g0 = int(offsets[out["ridx"]])
+                    num_cols = out["num_cols"]
+                    labels_parts.append(out["labels"])
+                    if weight_idx >= 0:
+                        weight_parts.append(out["weight"])
+                    if group_idx >= 0:
+                        group_parts.append(out["group"])
+                    if sample_idx is None:
+                        if "sample" in out:
+                            sample_parts.append(out["sample"])
+                    elif "sample" in out:
+                        if reservoir is None:
+                            reservoir = np.empty(
+                                (sample_idx.size, num_cols), np.float64)
+                        lo = np.searchsorted(sample_idx, g0)
+                        hi = np.searchsorted(sample_idx, g0 + n)
+                        reservoir[lo:hi] = out["sample"]
+                    telemetry.count("ingest/parse_us",
+                                    int(out["parse_us"]))
+                    tracing.record_ingest_chunk(
+                        1, out["ridx"], n, out["parse_us"], 0.0, 0.0,
+                        worker=out["pid"])
+                    start += n
+            finally:
+                pool.terminate()
+                pool.join()
+        tracing.record_ingest_pass(1, time.perf_counter() - t_pass, start)
+        log.check(start == total_rows,
+                  "Input file changed between the streaming passes "
+                  f"(pass 0: {total_rows} rows, pass 1: {start})")
+        if sample_idx is None:
+            sample = (np.concatenate(sample_parts) if sample_parts
+                      else np.zeros((0, 0), np.float64))
+        else:
+            sample = reservoir
+        del sample_parts, reservoir
+
+        ds.num_total_features = num_cols or 0
+        ds.feature_names = dataset_mod._make_feature_names(
+            header_names, ds.label_idx, ds.num_total_features)
+
+        ds._build_bin_mappers(sample, io_config.max_bin, bin_finder,
+                              ignore_set)
+        del sample
+
+        if weight_idx >= 0:
+            log.info("using weight in data file, and ignore additional "
+                     "weight file")
+            ds.metadata.weights = np.concatenate(weight_parts)
+        if group_idx >= 0:
+            log.info("using query id in data file, and ignore additional "
+                     "query file")
+            ds.metadata.query_boundaries = None
+            ds.metadata.set_queries_from_column(np.concatenate(group_parts))
+
+        all_labels = (np.concatenate(labels_parts) if labels_parts
+                      else np.zeros((0,), np.float32))
+        ds.metadata.set_label(all_labels)
+        if ds.used_data_indices is not None:
+            if ds.metadata.queries is not None:
+                ds.metadata.queries = \
+                    ds.metadata.queries[ds.used_data_indices]
+            ds.metadata.partition(ds.used_data_indices, total_rows)
+            ds.num_data = len(ds.used_data_indices)
+        else:
+            ds.num_data = total_rows
+        ds.metadata.finalize(ds.num_data)
+
+        # ---- pass 2 (pooled): workers parse+quantize their ranges —
+        # owned rows only under a shard mask (the pod-scale cut: the
+        # serial path tokenizes the full file on every host) — and the
+        # parent commits ranges in order: cache write, device append,
+        # init scores, counters
+        F_used = len(ds.bin_mappers)
+        dtype = dataset_mod._bin_dtype(
+            int(ds.num_bins.max()) if F_used else 256)
+        writer = (streaming.DeviceRowWriter(
+                      F_used, ds.num_data, dtype,
+                      sharding=streaming._placement(
+                          ds.num_data, shard_rows, shard_devices,
+                          device_type))
+                  if device_resident
+                  else streaming.HostRowWriter(F_used, ds.num_data, dtype))
+        cache = streaming._open_cache(ds, io_config, dtype,
+                                      (F_used, ds.num_data), foreign_bin)
+        sel_local = None
+        if ds.used_data_indices is not None:
+            owned = ds.used_data_indices
+            sel_local = []
+            for ridx in range(k):
+                g0, g1 = int(offsets[ridx]), int(offsets[ridx + 1])
+                lo = np.searchsorted(owned, g0)
+                hi = np.searchsorted(owned, g1)
+                sel_local.append((owned[lo:hi] - g0).astype(np.int64))
+        job2 = _Job(filename=filename, ranges=ranges, parser=parser,
+                    mappers=ds.bin_mappers,
+                    used_feature_map=ds.used_feature_map, dtype=dtype,
+                    sel_local=sel_local, num_cols=num_cols or 0,
+                    need_feats=predict_fun is not None)
+        init_scores = [] if predict_fun is not None else None
+        cursor = 0
+        start = 0
+        t_pass = time.perf_counter()
+        try:
+            pool = _pool(workers, job2)
+            try:
+                for (ridx, c0, n, binned, feats, parse_us, bin_us,
+                     pid) in _bounded_imap(pool, _pass2_range, k, window):
+                    with telemetry.span("ingest_bin"):
+                        t2 = time.perf_counter()
+                        if n:
+                            if init_scores is not None:
+                                init_scores.append(np.asarray(
+                                    predict_fun(feats),
+                                    np.float32).reshape(-1))
+                            if cache is not None:
+                                cache.write(binned, cursor)
+                            writer.append(binned, cursor)
+                        t_h2d = time.perf_counter()
+                    h2d_us = (t_h2d - t2) * 1e6
+                    telemetry.count("ingest/chunks")
+                    telemetry.count("ingest/rows", n)
+                    telemetry.count("ingest/parse_us", int(parse_us))
+                    telemetry.count("ingest/bin_us", int(bin_us))
+                    telemetry.count("ingest/h2d_us", int(h2d_us))
+                    tracing.record_ingest_chunk(2, ridx, n, parse_us,
+                                                bin_us, h2d_us,
+                                                worker=pid)
+                    cursor += n
+                    start += c0
+            finally:
+                pool.terminate()
+                pool.join()
+            log.check(start == total_rows and cursor == ds.num_data,
+                      "Input file changed between the streaming passes "
+                      f"(pass 1: {total_rows} rows, pass 2: {start})")
+            tracing.record_ingest_pass(2, time.perf_counter() - t_pass,
+                                       cursor)
+            t_fin = time.perf_counter()
+            out = writer.finish()
+            telemetry.count("ingest/h2d_us",
+                            int((time.perf_counter() - t_fin) * 1e6))
+            if device_resident:
+                ds.device_bins = out
+                ds.bins = None
+            else:
+                ds.bins = out
+            if init_scores is not None:
+                ds.metadata.init_score = np.concatenate(init_scores)
+            if cache is not None:
+                cache.finish()
+        except BaseException:
+            if cache is not None:
+                cache.abort()
+            raise
+
+
+# ------------------------------------------------------- worker entry
+
+
+def _worker_main() -> int:
+    """The exec'd worker loop: ``("job", job)`` lands per-pass state,
+    ``("task", fn_name, ridx)`` runs one range and answers
+    ``("ok", result)`` or ``("err", traceback)``, ``("exit",)``/EOF
+    stops.  The protocol owns the real stdout; accidental prints from
+    library code are re-routed to stderr."""
+    import pickle
+    import traceback
+    global _JOB
+    inp = sys.stdin.buffer
+    out = sys.stdout.buffer
+    sys.stdout = sys.stderr
+    while True:
+        try:
+            msg = pickle.load(inp)
+        except EOFError:
+            return 0
+        if msg[0] == "exit":
+            return 0
+        if msg[0] == "job":
+            _JOB = msg[1]
+            continue
+        try:
+            res = ("ok", globals()[msg[1]](msg[2]))
+        except BaseException:
+            res = ("err", traceback.format_exc())
+        pickle.dump(res, out, protocol=pickle.HIGHEST_PROTOCOL)
+        out.flush()
+
+
+if __name__ == "__main__":
+    sys.exit(_worker_main())

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import itertools
 import math
+import os
 from dataclasses import dataclass
 from typing import List, Optional, Tuple
 
@@ -470,3 +471,140 @@ def read_line_chunks(filename: str, skip_header: bool = False,
                     buf = []
         if buf:
             yield buf
+
+
+# --------------------------------------------------------- byte ranges
+#
+# Process-parallel ingest (io/parallel_ingest.py) hands each worker a
+# BYTE range of the file instead of a line range, so no two workers ever
+# read the same bytes.  Correctness rests on three facts about
+# ``read_line_chunks``'s semantics:
+#
+# - text mode is universal-newline: ``\r\n`` and lone ``\r`` translate
+#   to ``\n`` before iteration, so the row boundaries are exactly the
+#   bytes {0x0A, 0x0D} — and UTF-8 never embeds either inside a
+#   multibyte sequence, so byte-level snapping is encoding-safe;
+# - a data row is a maximal run of non-terminator bytes: blank physical
+#   lines (any mix of \r/\n) are dropped by the truthiness filter, and a
+#   missing final newline still yields the last line;
+# - \f/\v/ -class separators are NOT terminators (file iteration
+#   does not split on them; tests pin this), and they are non-terminator
+#   BYTES here, so they stay inside their run.
+#
+# Snapping a split point to the next run START therefore never lands
+# inside row content, and every terminator byte of a row sits before the
+# next run start — ranges partition the data bytes with zero overlap.
+
+_SCAN_BLOCK = 8 * 1024 * 1024
+
+
+def data_byte_start(filename: str, skip_header: bool = False) -> int:
+    """Byte offset of the first data byte — the byte-domain twin of the
+    ``f.readline()`` header consume in ``read_line_chunks`` (the header
+    is the first PHYSICAL line: up to and including the first ``\\n``,
+    ``\\r`` or ``\\r\\n``; a file with no terminator is all header)."""
+    if not skip_header:
+        return 0
+    with open(filename, "rb") as f:
+        pos = 0
+        pending_cr = False
+        while True:
+            block = f.read(_SCAN_BLOCK)
+            if not block:
+                return pos  # no terminator at all -> whole file is header
+            if pending_cr:
+                # header ended on a \r at the previous block's edge; a
+                # \n here belongs to the same \r\n terminator
+                return pos + (1 if block[0:1] == b"\n" else 0)
+            arr = np.frombuffer(block, dtype=np.uint8)
+            hits = np.nonzero((arr == 10) | (arr == 13))[0]
+            if hits.size == 0:
+                pos += len(block)
+                continue
+            i = int(hits[0])
+            if block[i:i + 1] == b"\n":
+                return pos + i + 1
+            if i + 1 < len(block):
+                return pos + i + 1 + (1 if block[i + 1:i + 2] == b"\n"
+                                      else 0)
+            pos += len(block)
+            pending_cr = True
+
+
+def split_byte_ranges_at(filename: str, candidates,
+                         skip_header: bool = False):
+    """Snap candidate byte offsets to data-row starts with ONE raw scan.
+
+    Returns ``(ranges, counts, total_rows)``: byte ranges
+    ``[(start, end), ...]`` covering the data region exactly once, the
+    data-row count of each range, and their sum — the same count
+    ``count_data_rows`` produces, so the split scan doubles as pass 0
+    (the file is read twice per load, not three times).  Each candidate
+    snaps FORWARD to the next row start (or EOF), so any candidate set —
+    mid-line, between the bytes of a ``\\r\\n``, inside the skipped
+    header, past EOF — yields ranges whose concatenated rows reproduce
+    the serial ``read_line_chunks`` sequence exactly."""
+    size = os.path.getsize(filename)
+    d0 = data_byte_start(filename, skip_header)
+    pending = sorted(min(max(int(c), d0), size) for c in candidates)
+    snapped: List[Tuple[int, int]] = []  # (byte offset, rows before it)
+    total = 0
+    in_run = False
+    pos = d0
+    with open(filename, "rb") as f:
+        f.seek(d0)
+        while True:
+            block = f.read(_SCAN_BLOCK)
+            if not block:
+                break
+            arr = np.frombuffer(block, dtype=np.uint8)
+            m = (arr != 10) & (arr != 13)
+            prev = np.empty_like(m)
+            prev[0] = in_run
+            prev[1:] = m[:-1]
+            starts = np.nonzero(m & ~prev)[0]
+            while pending and pending[0] < pos + len(block):
+                j = int(np.searchsorted(starts, pending[0] - pos))
+                if j >= starts.size:
+                    break  # snaps in a later block (or to EOF)
+                snapped.append((pos + int(starts[j]), total + j))
+                pending.pop(0)
+            total += int(starts.size)
+            in_run = bool(m[-1])
+            pos += len(block)
+    for _ in pending:
+        snapped.append((size, total))
+    bounds = [d0] + [b for b, _ in snapped] + [size]
+    cum = [0] + [c for _, c in snapped] + [total]
+    ranges = list(zip(bounds[:-1], bounds[1:]))
+    counts = [cum[i + 1] - cum[i] for i in range(len(ranges))]
+    return ranges, counts, total
+
+
+def split_byte_ranges(filename: str, num_ranges: int,
+                      skip_header: bool = False):
+    """Split the data region into ``num_ranges`` byte-balanced,
+    row-start-snapped ranges (see ``split_byte_ranges_at``)."""
+    size = os.path.getsize(filename)
+    d0 = data_byte_start(filename, skip_header)
+    num_ranges = max(int(num_ranges), 1)
+    span = max(size - d0, 0)
+    cands = [d0 + (span * i) // num_ranges for i in range(1, num_ranges)]
+    return split_byte_ranges_at(filename, cands, skip_header=skip_header)
+
+
+def read_range_lines(filename: str, start: int, end: int) -> List[str]:
+    """The data lines of one snapped byte range — bit-identical to the
+    slice of ``read_lines`` the range covers.  The replace chain IS
+    universal-newline translation; dropping empty segments IS the
+    truthiness filter (a \\r\\n "blank" line becomes one empty segment
+    on whichever side of a split it falls — dropped either way)."""
+    if end <= start:
+        return []
+    with open(filename, "rb") as f:
+        f.seek(start)
+        data = f.read(end - start)
+    text = data.decode()
+    if "\r" in text:
+        text = text.replace("\r\n", "\n").replace("\r", "\n")
+    return [ln for ln in text.split("\n") if ln]

@@ -229,6 +229,11 @@ class DeviceRowWriter:
                 self.wait_s = time.perf_counter() - t0
                 telemetry.count("ingest/h2d_wait_us",
                                 int(self.wait_s * 1e6))
+                # the one-shot staged commit hides nothing behind host
+                # work — file the zero explicitly so the derived overlap
+                # column (telemetry_report) has its counter on CPU
+                # rounds instead of dividing by a missing key
+                telemetry.count("ingest/overlap_hidden_us", 0)
                 self._stage = None
             else:
                 while self._pending:
@@ -348,6 +353,29 @@ def load_train_streaming(ds, io_config, parser, rank: int,
     chunk_rows = getattr(io_config, "ingest_chunk_rows", 200_000)
     device_resident = num_machines <= 1 and single_process()
 
+    # parallel byte-range ingest (ISSUE 18, io/parallel_ingest.py):
+    # engaged by ingest_workers > 1, and by ANY multi-process load (the
+    # pod-sharded parse: each host tokenizes only its own row shard).
+    # Bit-identical to the serial passes below by construction and by
+    # test pin (tests/test_parallel_ingest.py).
+    workers = int(getattr(io_config, "ingest_workers", 1) or 1)
+    ds.ingest_workers_requested = workers
+    if workers > 1 or num_machines > 1:
+        from . import parallel_ingest
+        if parallel_ingest.available():
+            return parallel_ingest.load_train_streaming_parallel(
+                ds, io_config, parser, rank, num_machines, predict_fun,
+                bin_finder, weight_idx, group_idx, ignore_set,
+                header_names, shard_rows=shard_rows,
+                shard_devices=shard_devices, device_type=device_type,
+                foreign_bin=foreign_bin, workers=workers)
+        if workers > 1:
+            log.warning(
+                "ingest_workers=%d requested but no worker interpreter "
+                "can be exec'd — parallel parse resolved to the serial "
+                "loader" % workers)
+    ds.ingest_workers_effective = 1
+
     with telemetry.span("ingest"):
         # ---- pass 0: count data rows (raw scan, no parse)
         t_pass = time.perf_counter()
@@ -369,13 +397,24 @@ def load_train_streaming(ds, io_config, parser, rank: int,
         reservoir = None
         num_cols = None
         start = 0
+        chunk1_no = 0
         t_pass = time.perf_counter()
         with telemetry.span("ingest_pass1"):
             for lines in parser_mod.prefetch_chunks(
                     parser_mod.read_line_chunks(
                         filename, skip_header=io_config.has_header,
                         chunk_lines=chunk_rows)):
+                t0 = time.perf_counter()
                 parsed = parser.parse(lines)
+                # pass-1 tokenization is parse cost too: without this the
+                # ingest/parse_us family under-reports exactly half the
+                # tokenizer wall (and the parallel path's selective
+                # pass-1 saving would be invisible to the attribution)
+                parse_us = (time.perf_counter() - t0) * 1e6
+                telemetry.count("ingest/parse_us", int(parse_us))
+                tracing.record_ingest_chunk(1, chunk1_no, len(lines),
+                                            parse_us, 0.0, 0.0)
+                chunk1_no += 1
                 feats = parsed.features
                 num_cols = feats.shape[1]
                 labels_parts.append(parsed.labels)
@@ -616,13 +655,35 @@ def load_binary_streaming(ds, path: str, io_config,
             shape[0], shape[1], dtype,
             sharding=_placement(shape[1], shard_rows, shard_devices,
                                 device_type))
+        # cache loads file the same pass/chunk attribution as the text
+        # path (pass 2 only, parse_us=0: there is no tokenizer here), so
+        # trace dumps and pod_report ingest attribution aren't blind on
+        # the fast path
+        t_pass = time.perf_counter()
+        chunk_no = 0
         if mm is not None:
             for s in range(0, shape[1], chunk_rows):
                 e = min(s + chunk_rows, shape[1])
                 with telemetry.span("ingest_bin"):
-                    writer.append(np.ascontiguousarray(mm[:, s:e]), s)
+                    t0 = time.perf_counter()
+                    chunk = np.ascontiguousarray(mm[:, s:e])
+                    t1 = time.perf_counter()
+                    writer.append(chunk, s)
+                    t2 = time.perf_counter()
+                bin_us = (t1 - t0) * 1e6
+                h2d_us = (t2 - t1) * 1e6
                 telemetry.count("ingest/chunks")
                 telemetry.count("ingest/rows", e - s)
+                telemetry.count("ingest/bin_us", int(bin_us))
+                telemetry.count("ingest/h2d_us", int(h2d_us))
+                tracing.record_ingest_chunk(2, chunk_no, e - s, 0.0,
+                                            bin_us, h2d_us)
+                chunk_no += 1
+        t_fin = time.perf_counter()
         ds.device_bins = writer.finish()
+        telemetry.count("ingest/h2d_us",
+                        int((time.perf_counter() - t_fin) * 1e6))
+        tracing.record_ingest_pass(2, time.perf_counter() - t_pass,
+                                   shape[1])
         ds.bins = None
         ds.metadata.finalize(ds.num_data)

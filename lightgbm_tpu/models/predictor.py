@@ -11,6 +11,8 @@ sigmoid, or raw scores.
 """
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from ..io import parser as parser_mod
@@ -78,7 +80,20 @@ class Predictor:
         (bucket padding never leaks), so the output file is
         BYTE-IDENTICAL at any chunk length — tests pin streamed ==
         resident.  The ensemble encode is NOT per-chunk: the engine
-        built in __init__ carries it."""
+        built in __init__ carries it.
+
+        A native columnar-binary cache as ``data=`` (header-sniffed,
+        ISSUE 18b) scores without any text parse: bin codes are memmapped
+        and decoded through each mapper's ``bin_representatives`` —
+        values that land in the same bins the original rows did, so the
+        trees (whose thresholds ARE bin upper bounds) traverse
+        identically."""
+        from ..io.dataset import Dataset
+        if (os.path.exists(data_filename)
+                and Dataset._classify_binary_cache(data_filename)
+                == "ours"):
+            return self._predict_binary_file(data_filename,
+                                             result_filename, chunk_lines)
         parser = parser_mod.create_parser(data_filename, has_header,
                                           self.num_features,
                                           self.boosting.label_idx)
@@ -94,13 +109,61 @@ class Predictor:
             for features in parser_mod.prefetch_chunks(_parsed_features(),
                                                        depth=depth):
                 result = self.predict_matrix(features)
-                if result.ndim == 1:
-                    for v in result:
-                        f.write(_fmt(v) + "\n")
-                else:
-                    for row in result:
-                        f.write("\t".join(_fmt(v) for v in row) + "\n")
+                self._write_chunk(f, result)
         log.info("Finished prediction, result saved to %s" % result_filename)
+
+    def _predict_binary_file(self, data_filename: str,
+                             result_filename: str,
+                             chunk_lines: int) -> None:
+        """Score a native binary cache directly: memmap the ``[F, N]``
+        bin matrix, reconstruct a representative feature matrix per row
+        chunk (in the parser's label-removed column space — exactly what
+        ``predict_matrix`` expects), and stream the same formatted
+        writes as the text path."""
+        import pickle
+
+        from ..io.binning import BinMapper
+        from ..io.dataset import BINARY_MAGIC
+
+        try:
+            with open(data_filename, "rb") as f:
+                f.read(len(BINARY_MAGIC))
+                size = int.from_bytes(f.read(8), "little")
+                header = pickle.loads(f.read(size))
+                offset = f.tell()
+        except Exception as e:
+            log.fatal("Binary file %s is a damaged lightgbm_tpu cache "
+                      "(%s) — delete it to regenerate"
+                      % (data_filename, e))
+        mappers = [BinMapper.from_bytes(b) for b in header["mappers"]]
+        reps = [m.bin_representatives() for m in mappers]
+        used_map = header["used_feature_map"]
+        num_total = int(header["num_total_features"])
+        shape = tuple(header["bins_shape"])
+        mm = (np.memmap(data_filename,
+                        dtype=np.dtype(header["bins_dtype"]), mode="r",
+                        offset=offset, shape=shape)
+              if shape[0] * shape[1] else None)
+        with open(result_filename, "w") as f:
+            for s in range(0, shape[1], chunk_lines):
+                e = min(s + chunk_lines, shape[1])
+                features = np.zeros((e - s, num_total), dtype=np.float64)
+                if mm is not None:
+                    for j_raw, j_inner in used_map.items():
+                        features[:, j_raw] = \
+                            reps[j_inner][np.asarray(mm[j_inner, s:e])]
+                self._write_chunk(f, self.predict_matrix(features))
+        log.info("Finished prediction, result saved to %s"
+                 % result_filename)
+
+    @staticmethod
+    def _write_chunk(f, result: np.ndarray) -> None:
+        if result.ndim == 1:
+            for v in result:
+                f.write(_fmt(v) + "\n")
+        else:
+            for row in result:
+                f.write("\t".join(_fmt(v) for v in row) + "\n")
 
 
 def _fmt(v) -> str:

@@ -336,7 +336,11 @@ def ingest_breakdown(dumps: List[dict]) -> Dict[str, dict]:
         for ev in d["events"]:
             if ev.get("kind") == "ingest_chunk":
                 chunks += 1
-                rows += int(ev.get("rows", 0))
+                # rows counts ingested rows: pass-2 chunks only — the
+                # pass-1 label/sample chunks cover the same rows again
+                # and would double the count (phase sums stay all-pass)
+                if int(ev.get("pass", 2)) == 2:
+                    rows += int(ev.get("rows", 0))
                 for k in tot:
                     tot[k] += float(ev.get(k, 0.0))
             elif ev.get("kind") == "ingest_pass":

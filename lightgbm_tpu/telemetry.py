@@ -138,7 +138,10 @@ ISSUE 8 — streaming ingestion (io/streaming.py):
    transfers) and ``ingest/overlap_hidden_us`` (upper-bound estimate of
    wire time hidden behind host parse/bin work — the double buffer's
    win; ``LGBM_TPU_INGEST_SYNC=1`` forces depth-0 transfers for the
-   bench A/B).  Routes: ``ingest/double_buffer_on|off``.  Device-side
+   bench A/B) and ``ingest/worker_wait_us`` (parallel-parse pool time
+   the coordinator spent blocked on the bounded in-flight window —
+   io/parallel_ingest.py, ISSUE 18).  Routes:
+   ``ingest/double_buffer_on|off``.  Device-side
    sampling rides the same registry: ``bagging/device`` vs
    ``bagging/host`` routes (ops/sampling.py draws vs the legacy host
    RNG + full-N upload) and the ``goss/iterations`` counter under a
@@ -258,6 +261,7 @@ COUNTER_FAMILIES = (
     "ingest/overlap_hidden_us",
     "ingest/parse_us",
     "ingest/rows",
+    "ingest/worker_wait_us",
     "jit/backend_compile",
     "jit/midrun_recompile",
     "jit/persistent_cache_hit",

@@ -434,12 +434,15 @@ def record_ingest_pass(pass_no: int, seconds: float, rows: int) -> None:
 
 def record_ingest_chunk(pass_no: int, chunk: int, rows: int,
                         parse_us: float, bin_us: float,
-                        h2d_us: float) -> None:
+                        h2d_us: float, worker: int = None) -> None:
     """File one streamed chunk's phase split — tokenizer (parse) vs
     value->bin mapping vs H2D handoff (device_put + row-writer append;
     the async tail is priced by the ``ingest_h2d`` span at finish).
     Sketches accumulate each phase so a dump explains WHERE the
-    declining ingest_rows_per_sec lane spends its time."""
+    declining ingest_rows_per_sec lane spends its time.  ``worker``
+    tags events from the parallel byte-range loader with the worker
+    process id, so per-worker parse spans are reconstructable from the
+    ring."""
     if not _armed:
         return
     ev = {"kind": "ingest_chunk", "t": round(time.time(), 6),
@@ -447,6 +450,8 @@ def record_ingest_chunk(pass_no: int, chunk: int, rows: int,
           "parse_us": round(float(parse_us), 1),
           "bin_us": round(float(bin_us), 1),
           "h2d_us": round(float(h2d_us), 1)}
+    if worker is not None:
+        ev["worker"] = int(worker)
     with _lock:
         if _armed:
             _append_locked(ev)

@@ -290,6 +290,22 @@ def _attach_multichip_obs(rec: dict) -> None:
             if isinstance(pt, dict):
                 rec["podtrace"] = pt
             break
+    if "sharded_ingest" not in rec:
+        # ISSUE 18: the multi-host sharded-ingest row prints one
+        # MULTICHIP_SHARDED_INGEST JSON line (every rank parses only
+        # its own row shard's byte ranges — per-host parsed-row counts
+        # must tile the dataset exactly, zero overlap)
+        for line in reversed(lines):
+            line = line.strip()
+            if not line.startswith("MULTICHIP_SHARDED_INGEST "):
+                continue
+            try:
+                si = json.loads(line[len("MULTICHIP_SHARDED_INGEST "):])
+            except ValueError:
+                break
+            if isinstance(si, dict):
+                rec["sharded_ingest"] = si
+            break
 
 
 def _fractions(rec: dict) -> Dict[str, float]:
@@ -367,6 +383,7 @@ def _check_group(metric: str, entries: List[dict], floor: float,
                     "detail": detail,
                 })
     _check_mixedbin_resolution(metric, entries[-1], findings)
+    _check_ingest_workers(metric, entries, findings)
     if len(entries) < 2:
         return
     latest_round = entries[-1]["round"]
@@ -450,6 +467,77 @@ def _check_mixedbin_resolution(metric: str, latest: dict,
                           "mixed-cardinality table but resolved the "
                           "uniform layout (block-local packing silently "
                           "fell back)" % (learner, requested),
+            })
+
+
+def _check_ingest_workers(metric: str, entries: List[dict],
+                          findings: List[dict]) -> None:
+    """ISSUE 18: the parallel-ingest lanes.  Two contracts, checked on
+    EVERY round that recorded ``ingest_workers > 1`` (like the
+    mixed-bin resolution check, these are claims about that round, not
+    trajectories):
+
+    - must-GROW: a round that ran the byte-range worker pool exists to
+      beat the serial tokenizer — its ``ingest_rows_per_sec`` must
+      strictly exceed the serial baseline.  The baseline is the
+      round's OWN recorded ``ingest_serial_rows_per_sec`` when present
+      (the bench lane prices both loaders on the same file, same scale,
+      same host — the matched comparison), else the median of all
+      strictly-earlier rounds that did NOT record
+      ``ingest_workers > 1`` (the r06-r08 serial history).  A parallel
+      round at-or-below serial throughput means the fan-out stopped
+      paying and must not pass unnoticed.  Skipped when neither
+      baseline exists.
+    - absolute: a round that REQUESTED workers but recorded
+      ``ingest_workers_effective <= 1`` silently resolved to the serial
+      loader (fork unavailable, or the dispatch fell through) — the
+      lane would then gate serial numbers as if they were parallel."""
+    for i, e in enumerate(entries):
+        rec = e["rec"]
+        workers = rec.get("ingest_workers")
+        if not isinstance(workers, (int, float)) or workers <= 1:
+            continue
+        effective = rec.get("ingest_workers_effective")
+        if isinstance(effective, (int, float)) and effective <= 1:
+            findings.append({
+                "metric": metric, "key": "ingest_workers_effective",
+                "latest_round": e["round"],
+                "latest": effective, "baseline": workers,
+                "detail": "round requested ingest_workers=%d but the "
+                          "load resolved to the serial parse silently "
+                          "(effective=%d)" % (workers, effective),
+            })
+        rate = rec.get("ingest_rows_per_sec")
+        if not isinstance(rate, (int, float)):
+            continue
+        own_serial = rec.get("ingest_serial_rows_per_sec")
+        if isinstance(own_serial, (int, float)):
+            baseline = float(own_serial)
+        else:
+            serial_prior = [
+                float(p["rec"]["ingest_rows_per_sec"])
+                for p in entries[:i]
+                if isinstance(p["rec"].get("ingest_rows_per_sec"),
+                              (int, float))
+                and not (isinstance(p["rec"].get("ingest_workers"),
+                                    (int, float))
+                         and p["rec"]["ingest_workers"] > 1)]
+            if not serial_prior:
+                continue
+            baseline = _median(serial_prior)
+        if baseline > 0 and float(rate) <= baseline:
+            findings.append({
+                "metric": metric, "key": "ingest_rows_per_sec_must_grow",
+                "latest_round": e["round"],
+                "latest": float(rate), "baseline": round(baseline, 6),
+                "detail": "round ran ingest_workers=%d but "
+                          "ingest_rows_per_sec did not grow past the "
+                          "serial baseline (%s) — the parallel parse "
+                          "stopped paying"
+                          % (workers,
+                             "same-record serial lane"
+                             if isinstance(own_serial, (int, float))
+                             else "serial-round median"),
             })
 
 
@@ -626,6 +714,56 @@ def _check_podtrace(entries: List[dict], findings: List[dict],
         })
 
 
+def _check_sharded_ingest(entries: List[dict],
+                          findings: List[dict]) -> None:
+    """ISSUE 18c: the multi-host sharded-ingest row from the
+    MULTICHIP_SHARDED_INGEST block.  Absolute per-round contracts (no
+    trajectory): every rank parses only its own row shard's byte
+    ranges, so the per-host parsed-row counts must sum to the dataset
+    with zero overlap, tile it exactly (coverage), and bin
+    bit-identically to the serial masked load."""
+    for e in sorted(entries, key=lambda e: e["round"]):
+        si = e["rec"].get("sharded_ingest")
+        if not isinstance(si, dict):
+            continue
+        host_rows = si.get("host_rows")
+        total = si.get("total")
+        rows_sum = (sum(host_rows) if isinstance(host_rows, list)
+                    and all(isinstance(v, (int, float))
+                            for v in host_rows) else None)
+        checks = (
+            ("ok", si.get("ok") is False, si.get("ok"),
+             "the sharded-ingest smoke failed outright"),
+            ("host_rows_sum",
+             rows_sum is not None and isinstance(total, (int, float))
+             and rows_sum != total, rows_sum,
+             "per-host parsed-row counts do not sum to the dataset "
+             "(%s != %s)" % (rows_sum, total)),
+            ("overlap",
+             isinstance(si.get("overlap"), (int, float))
+             and si["overlap"] > 0, si.get("overlap"),
+             "hosts parsed overlapping global rows — shard ownership "
+             "leaked across ranks"),
+            ("coverage_ok", si.get("coverage_ok") is False,
+             si.get("coverage_ok"),
+             "the union of per-host row shards does not tile the "
+             "dataset exactly"),
+            ("bit_identical", si.get("bit_identical") is False,
+             si.get("bit_identical"),
+             "a host's sharded parse binned differently from the "
+             "serial masked load"),
+        )
+        for key, bad, latest, detail in checks:
+            if bad:
+                findings.append({
+                    "metric": "multichip",
+                    "key": "sharded_ingest/" + key,
+                    "latest_round": e["round"],
+                    "latest": latest, "baseline": None,
+                    "detail": detail,
+                })
+
+
 def _check_wire(entries: List[dict], findings: List[dict],
                 floor: float = DEFAULT_FLOOR,
                 sigma_mult: float = DEFAULT_SIGMA_MULT) -> None:
@@ -705,6 +843,7 @@ def check_files(paths: List[str], floor: float = DEFAULT_FLOOR,
                     floor=floor, sigma_mult=sigma_mult)
         _check_podtrace(multichip, findings, floor=floor,
                         sigma_mult=sigma_mult)
+        _check_sharded_ingest(multichip, findings)
     return {
         "files": len(entries),
         "groups": {m: len(g) for m, g in sorted(groups.items())},

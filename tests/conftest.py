@@ -17,6 +17,18 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache for the suite: the tier-1 wall is
+# compile-bound (the unrolled grower programs dominate), and the cache
+# is content-addressed on the HLO — edited programs recompile, unchanged
+# ones load hot.  Local per-machine path, never shared across hosts, so
+# the heterogeneous-host SIGILL hazard that keeps the CPU cache off in
+# lightgbm_tpu/__init__.py does not arise.
+if jax.config.jax_compilation_cache_dir is None:
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.expanduser("~/.cache/lightgbm_tpu_xla_tests"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
 import shutil
 import subprocess
 

@@ -451,3 +451,121 @@ def test_real_bench_trajectory_passes():
     report = perf_gate.check_files(paths)
     assert report["findings"] == [], report["findings"]
     assert len(report["groups"]) >= 1
+
+
+# ------------------------------------------------- parallel-ingest lanes
+
+def test_ingest_workers_must_grow_flagged(tmp_path):
+    """ISSUE 18: a round that ran the byte-range worker pool but whose
+    ingest_rows_per_sec sits at/below the serial-round median is a
+    finding — the fan-out stopped paying."""
+    paths = _history(tmp_path, [1.67, 1.67, 1.67],
+                     extra={"ingest_rows_per_sec": 116000.0})
+    paths.append(_write_round(
+        tmp_path, 4, 1.67,
+        extra={"ingest_rows_per_sec": 115000.0, "ingest_workers": 2,
+               "ingest_workers_effective": 2}))
+    report = perf_gate.check_files(paths)
+    keys = [f["key"] for f in report["findings"]]
+    assert "ingest_rows_per_sec_must_grow" in keys
+
+
+def test_ingest_workers_growth_passes(tmp_path):
+    paths = _history(tmp_path, [1.67, 1.67, 1.67],
+                     extra={"ingest_rows_per_sec": 116000.0})
+    paths.append(_write_round(
+        tmp_path, 4, 1.67,
+        extra={"ingest_rows_per_sec": 140000.0, "ingest_workers": 2,
+               "ingest_workers_effective": 2}))
+    assert perf_gate.check_files(paths)["findings"] == []
+
+
+def test_ingest_workers_own_serial_lane_is_the_baseline(tmp_path):
+    """A workers round that records its own serial reference lane is
+    judged against THAT (same file, same scale, same host) — beating a
+    cross-round median while losing to the matched serial lane is still
+    a finding, and vice versa."""
+    paths = _history(tmp_path, [1.67, 1.67],
+                     extra={"ingest_rows_per_sec": 116000.0})
+    paths.append(_write_round(
+        tmp_path, 3, 1.67,
+        extra={"ingest_rows_per_sec": 150000.0,
+               "ingest_serial_rows_per_sec": 155000.0,
+               "ingest_workers": 2, "ingest_workers_effective": 2}))
+    report = perf_gate.check_files(paths)
+    keys = [f["key"] for f in report["findings"]]
+    assert "ingest_rows_per_sec_must_grow" in keys
+    # and the matched lane passing is a pass even with a higher median
+    ok = _write_round(
+        tmp_path, 4, 1.67,
+        extra={"ingest_rows_per_sec": 170000.0,
+               "ingest_serial_rows_per_sec": 155000.0,
+               "ingest_workers": 2, "ingest_workers_effective": 2})
+    report2 = perf_gate.check_files(paths[:2] + [ok])
+    assert report2["findings"] == []
+
+
+def test_ingest_workers_silent_serial_flagged(tmp_path):
+    """A round that REQUESTED workers but resolved to the serial loader
+    (effective <= 1) must not gate serial numbers as parallel ones."""
+    paths = _history(tmp_path, [1.67, 1.67],
+                     extra={"ingest_rows_per_sec": 116000.0})
+    paths.append(_write_round(
+        tmp_path, 3, 1.67,
+        extra={"ingest_rows_per_sec": 150000.0, "ingest_workers": 4,
+               "ingest_workers_effective": 1}))
+    report = perf_gate.check_files(paths)
+    keys = [f["key"] for f in report["findings"]]
+    assert "ingest_workers_effective" in keys
+
+
+def test_ingest_workers_no_serial_prior_skipped(tmp_path):
+    """A trajectory whose EVERY round ran workers has no serial baseline
+    to grow past — the must-GROW lane stays silent."""
+    paths = _history(tmp_path, [1.67, 1.67, 1.67],
+                     extra={"ingest_rows_per_sec": 140000.0,
+                            "ingest_workers": 2,
+                            "ingest_workers_effective": 2})
+    assert perf_gate.check_files(paths)["findings"] == []
+
+
+# ------------------------------------------------- sharded-ingest contracts
+
+def _write_sharded(tmp_path, n, si, via_tail=False):
+    rec = {"n_devices": 8, "rc": 0, "ok": True}
+    if via_tail:
+        rec["tail"] = ("[LightGBM] [Info] whatever\n"
+                       "MULTICHIP_SHARDED_INGEST " + json.dumps(si) + "\n")
+    else:
+        rec["sharded_ingest"] = si
+    path = tmp_path / f"MULTICHIP_r{n:02d}.json"
+    path.write_text(json.dumps(rec))
+    return str(path)
+
+
+def _good_sharded():
+    return {"n_hosts": 4, "total": 409, "host_rows": [113, 101, 93, 102],
+            "overlap": 0, "coverage_ok": True, "bit_identical": True,
+            "workers": 2, "ok": True}
+
+
+def test_sharded_ingest_clean_row_passes(tmp_path):
+    paths = [_write_sharded(tmp_path, 1, _good_sharded()),
+             _write_sharded(tmp_path, 2, _good_sharded(), via_tail=True)]
+    assert perf_gate.check_files(paths)["findings"] == []
+
+
+def test_sharded_ingest_contracts_flagged(tmp_path):
+    """Per-host rows failing to tile the dataset, any overlap, or a
+    bit-identity break are absolute findings on the recording round."""
+    bad = _good_sharded()
+    bad.update({"host_rows": [113, 101, 93, 107], "overlap": 5,
+                "bit_identical": False})
+    paths = [_write_sharded(tmp_path, 1, _good_sharded()),
+             _write_sharded(tmp_path, 2, bad, via_tail=True)]
+    report = perf_gate.check_files(paths)
+    keys = {f["key"] for f in report["findings"]}
+    assert "sharded_ingest/host_rows_sum" in keys
+    assert "sharded_ingest/overlap" in keys
+    assert "sharded_ingest/bit_identical" in keys
+    assert all(f["latest_round"] == 2 for f in report["findings"])
