@@ -22,6 +22,7 @@ import json
 import math
 import os
 import sys
+import tempfile
 import time
 
 import numpy as np
@@ -292,6 +293,12 @@ SERVE_COPY_KEYS = (
     "serve_swap_drain_ms", "serve_coalesced_batches",
     "serve_mean_batch_rows", "serve_shards_used",
     "trace_overhead_pct", "trace_spread", "trace_dropped_at_default",
+    # live-monitor lane (ISSUE 20): monitor_overhead_pct is
+    # must-not-grow (band monitor_spread); drift_aa_psi above the A/A
+    # bound and monitor_slo_breaches > 0 without monitor_induced_fault
+    # are ABSOLUTE findings
+    "monitor_overhead_pct", "monitor_spread", "drift_aa_psi",
+    "monitor_slo_breaches", "monitor_induced_fault",
 )
 
 
@@ -318,7 +325,13 @@ def bench_serve(args) -> int:
     per-request latencies and pinned against the sorted sample within
     bucket resolution.  Each armed window uses a fresh DEFAULT-size
     ring, so ``trace_dropped_at_default`` > 0 means one ~2 s window
-    overflowed the default ring — an absolute perf_gate finding."""
+    overflowed the default ring — an absolute perf_gate finding.
+
+    Live monitor (ISSUE 20): a third interleave prices the armed
+    monitor on top of the recorder (``monitor_overhead_pct``), runs a
+    generous SLO that must NOT breach on healthy load
+    (``monitor_slo_breaches``) and reports the A/A drift false-positive
+    floor (``drift_aa_psi``)."""
     import jax  # noqa: F401  (device init before timing)
     from lightgbm_tpu import costmodel, telemetry, tracing
     from lightgbm_tpu.config import OverallConfig
@@ -507,8 +520,51 @@ def bench_serve(args) -> int:
                 or np.array_equal(got, ref_b[:, s:s + n])):
             misscored += 1
 
+    # ---- phase 3: live-monitor cost (ISSUE 20), interleaved monitor-ON
+    # / monitor-OFF segments with the recorder armed in BOTH (the
+    # shipped default) — the delta prices ONLY the monitor: the
+    # per-batch score feed, the emitter's windowed differencing and the
+    # JSONL append.  The ON segments also run a generous SLO (20x the
+    # measured healthy p99) so a breach on a no-fault bench round is an
+    # absolute perf_gate finding, and the last segment's A/A PSI rides
+    # out as drift_aa_psi — the measured false-positive floor.
+    from lightgbm_tpu import monitor
+    mon_samples, mon_off_samples = [], []
+    mon_breaches = 0
+    mon_aa_psi = None
+    mon_slo_us = 20.0 * bench_sk.quantile(0.99)
+    with tempfile.TemporaryDirectory() as mon_td:
+        for rep in range(2 * max(1, args.repeats)):
+            on = rep % 2 == 0
+            tracing.arm()               # recorder on in BOTH segments
+            if on:
+                monitor.arm(out_path=os.path.join(
+                                mon_td, "monitor-%d.jsonl" % rep),
+                            interval_s=0.5, slo_p99_us=mon_slo_us,
+                            slo_window_s=6.0)
+            front = ServingFront(eng_a, linger_us=linger_us)
+            t0 = time.perf_counter()
+            records, _ = open_loop(front, duration_s=2.0)
+            front.close()
+            wall = time.perf_counter() - t0
+            done_rows = sum(r["n"] for r in records if "t_done" in r)
+            if on:
+                mon_samples.append(done_rows / wall)
+                aa = monitor.aa_verdict(front._monitor_key)
+                if aa["psi"] is not None:
+                    mon_aa_psi = aa["psi"]
+                mon_breaches += monitor.monitor_snapshot().get(
+                    "breaches", 0)
+                monitor.disarm()
+            else:
+                mon_off_samples.append(done_rows / wall)
+            tracing.disarm()
+
     med = float(np.median(samples))
     off_med = float(np.median(off_samples)) if off_samples else med
+    mon_med = float(np.median(mon_samples)) if mon_samples else med
+    mon_off_med = (float(np.median(mon_off_samples))
+                   if mon_off_samples else mon_med)
     # sketch percentiles, A/B-pinned against the sorted sample at the
     # same nearest-rank convention: agreement within the sketch's bucket
     # resolution (a factor sqrt(growth)) is a mathematical guarantee —
@@ -572,6 +628,24 @@ def bench_serve(args) -> int:
         "trace_spread": max(_spread(samples, med),
                             _spread(off_samples, off_med)),
         "trace_dropped_at_default": int(dropped_at_default),
+        # live-monitor cost (ISSUE 20): throughput lost with the monitor
+        # armed on top of the recorder, from the phase-3 interleave —
+        # must-not-grow in perf_gate with monitor_spread as its band
+        "monitor_overhead_pct": round(
+            100.0 * (mon_off_med - mon_med) / mon_off_med, 2)
+            if mon_off_med > 0 else 0.0,
+        "monitor_spread": max(_spread(mon_samples, mon_med),
+                              _spread(mon_off_samples, mon_off_med)),
+        # A/A PSI on the last monitored segment's own scores: the
+        # measured drift false-positive floor (absolute perf_gate
+        # finding above monitor.AA_PSI_BOUND)
+        "drift_aa_psi": round(mon_aa_psi, 5)
+                        if mon_aa_psi is not None else None,
+        # breaches fired under a 20x-generous SLO on healthy load: any
+        # nonzero on a round not declaring an induced fault is an
+        # absolute perf_gate finding
+        "monitor_slo_breaches": int(mon_breaches),
+        "monitor_induced_fault": False,
     }
     if wall_sk is not None:
         # recorder-side enqueue→complete wall percentiles (the traced

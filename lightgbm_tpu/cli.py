@@ -170,6 +170,10 @@ KNOB_INVENTORY = {
     "trace_dump_dir": "flight-recorder JSONL dump dir (close + fault)",
     "trace_sketch_growth": "latency-sketch log-bucket growth factor",
     "trace_run_id": "run tag in dump headers (podtrace merge key)",
+    "monitor_out": "live-monitor windowed-snapshot JSONL path",
+    "monitor_interval_s": "windowed-snapshot interval (seconds, > 0)",
+    "slo_p99_us": "serve p99 latency objective (0 = SLO tracking off)",
+    "slo_window_s": "SLO error-budget window (seconds, > 0)",
     # serving
     "predict_buckets": "compiled batch-shape ladder (comma ints)",
     "predict_quantize": "float32 or int8 leaf-value serving tables",
@@ -280,6 +284,24 @@ class Application:
                       "timeline=%s trace_ring=%d"
                       % (io.metrics_out, io.metrics_fence, mem_on,
                          io.timeline, io.trace_ring_events))
+        if io.monitor_out or io.slo_p99_us > 0:
+            # live monitor (ISSUE 20): windowed snapshots / SLO burn /
+            # score drift, layered on the recorder armed above (an SLO
+            # without a sink still tracks — breaches land in the trace
+            # ring).  telemetry.disable() flushes and disarms it.
+            if not tracing.active():
+                tracing.set_identity(run_id=io.trace_run_id)
+                tracing.arm(ring_events=io.trace_ring_events,
+                            dump_dir=io.trace_dump_dir or None,
+                            sketch_growth=io.trace_sketch_growth)
+            from . import monitor
+            monitor.arm(out_path=io.monitor_out,
+                        interval_s=io.monitor_interval_s,
+                        slo_p99_us=io.slo_p99_us,
+                        slo_window_s=io.slo_window_s)
+            log.debug("monitor armed: out=%s interval=%.3fs slo_p99_us=%g"
+                      % (io.monitor_out, io.monitor_interval_s,
+                         io.slo_p99_us))
         if io.stall_timeout > 0:
             # hung-collective flight recorder (ISSUE 5): gbdt.run_training
             # arms the watchdog thread around the training loop

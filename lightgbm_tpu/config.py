@@ -186,6 +186,26 @@ class IOConfig:
     # mismatched run ids (mixing runs is a loud BadDump, never a
     # silently wrong merge); "" leaves dumps untagged.
     trace_run_id: str = ""
+    # Live monitoring (ISSUE 20, lightgbm_tpu/monitor.py): windowed
+    # metrics / SLO burn rate / score drift, layered on telemetry +
+    # tracing.  monitor_out: JSONL file the emitter thread appends one
+    # windowed snapshot per interval to (render/validate with
+    # scripts/monitor_report.py); "" = monitor off unless an SLO is
+    # declared.
+    monitor_out: str = ""
+    # monitor_interval_s: window length of the snapshot ring (seconds,
+    # > 0) — each window carries exact counter and sketch DELTAS since
+    # the previous one.
+    monitor_interval_s: float = 1.0
+    # slo_p99_us: declarative latency objective for the serving front's
+    # serve_wall_us family — a p99 target grants a 1% error budget;
+    # breach = fast short-window burn >= 5x AND slow long-window burn
+    # >= 1x.  0 disables SLO tracking (predict-task only: there is no
+    # serving latency to burn under task=train).
+    slo_p99_us: float = 0.0
+    # slo_window_s: the SLO error-budget window (seconds, > 0); the
+    # fast window is 1/12 of it.
+    slo_window_s: float = 60.0
     output_result: str = "LightGBM_predict_result.txt"
     input_model: str = ""
     input_init_score: str = ""
@@ -359,6 +379,29 @@ class IOConfig:
                       "whitespace (it lands verbatim in dump headers "
                       "and report keys)")
             self.trace_run_id = value
+        if "monitor_out" in params:
+            self.monitor_out = params["monitor_out"]
+            if self.monitor_out:
+                # loud reject at parse time (ISSUE 20): an unwritable
+                # monitor sink would otherwise fail silently at the one
+                # moment it matters — inside a crash flush
+                parent = os.path.dirname(self.monitor_out) or "."
+                log.check(os.path.isdir(parent)
+                          and os.access(parent, os.W_OK),
+                          "monitor_out parent must be a writable "
+                          "directory")
+        self.monitor_interval_s = _get_float(params, "monitor_interval_s",
+                                             self.monitor_interval_s)
+        log.check(self.monitor_interval_s > 0.0,
+                  "monitor_interval_s should be > 0 (the windowed-"
+                  "snapshot interval)")
+        self.slo_p99_us = _get_float(params, "slo_p99_us", self.slo_p99_us)
+        log.check(self.slo_p99_us >= 0.0,
+                  "slo_p99_us should be >= 0 (0 disables SLO tracking)")
+        self.slo_window_s = _get_float(params, "slo_window_s",
+                                       self.slo_window_s)
+        log.check(self.slo_window_s > 0.0,
+                  "slo_window_s should be > 0 (the error-budget window)")
         self.num_model_predict = _get_int(params, "num_model_predict", self.num_model_predict)
         self.predict_buckets = _get_str(params, "predict_buckets",
                                         self.predict_buckets)
@@ -952,6 +995,11 @@ class OverallConfig:
             log.fatal("elastic_shrink=true requires a parallel "
                       "tree_learner and num_machines > 1 (there is no "
                       "mesh to shrink under serial training)")
+        if self.io_config.slo_p99_us > 0 and self.task_type != "predict":
+            log.fatal("slo_p99_us > 0 requires task=predict (the SLO "
+                      "watches the serving front's serve_wall_us "
+                      "family; a training run has no serving latency "
+                      "to burn)")
         if self.boosting_config.tree_learner in ("serial", "feature"):
             self.is_parallel_find_bin = False
         elif self.boosting_config.tree_learner in ("data", "hybrid",

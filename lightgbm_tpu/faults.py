@@ -156,6 +156,9 @@ def maybe_fire(iteration: int) -> None:
         # ring BEFORE the raise — run_training's crash path also dumps,
         # but a raise escaping outside run_training would otherwise
         # leave no timeline at all
-        from . import tracing
+        from . import monitor, tracing
+        # close the monitor's in-flight window first: its slo_breach /
+        # monitor_window events must be IN the ring the dump flushes
+        monitor.flush_on_fault("injected_raise")
         tracing.dump_on_fault("injected_raise")
         raise RuntimeError("injected fault at iteration %d" % iteration)

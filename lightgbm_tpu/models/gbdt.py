@@ -14,6 +14,7 @@ rows (in-bag and out-of-bag), so the reference's separate OOB traversal path
 from __future__ import annotations
 
 import functools
+import json
 import os
 import time
 from typing import Callable, List, Optional
@@ -70,6 +71,12 @@ class GBDT:
         self.best_score = []
         self.best_iter = []
         self.early_stopping_round = 0
+        # training-time score-distribution reference (ISSUE 20): the
+        # serialized monitor.ScoreHistogram captured from the live
+        # training scores, saved as the model file's
+        # ``score_reference=`` metadata line — the baseline the serving
+        # drift detector compares live scores against
+        self.score_reference: Optional[dict] = None
         self._saved_model_size = -1
         self._model_file = None
         self._learner_factory: Optional[Callable] = None
@@ -2454,6 +2461,36 @@ class GBDT:
     # rows x trees volume the host numpy walk wins
     _DEVICE_PREDICT_THRESHOLD = 20_000_000
 
+    def capture_score_reference(self) -> Optional[dict]:
+        """Serialize the live training scores into a
+        monitor.ScoreHistogram dict — the drift-detection baseline
+        (ISSUE 20).  Recaptured from the CURRENT scores on every call
+        while the booster holds score state, so a mid-training
+        checkpoint save cannot freeze an early-iteration reference into
+        a later final model (the elastic resume path compares final
+        model text byte-for-byte).  A booster with no score state
+        (fresh load, prediction-only) keeps the reference
+        ``models_from_string`` parsed, or returns None."""
+        score = getattr(self, "score", None)
+        if score is None:
+            return self.score_reference
+        try:
+            from ..monitor import ScoreHistogram
+            values = np.asarray(score, dtype=np.float64)
+            # true rows only: per-topology padding rows accumulate leaf
+            # values too, and two topologies pad differently — the
+            # reference must not depend on the mesh shape
+            n = int(getattr(self, "num_data", 0)) or values.shape[-1]
+            values = values[..., :n].ravel()
+            if values.size == 0:
+                return None
+            hist = ScoreHistogram()
+            hist.record_many(values)
+            self.score_reference = hist.to_dict()
+        except Exception:
+            return None
+        return self.score_reference
+
     def export_flat(self, num_models: int = -1):
         """Flatten the first ``num_models`` trees (all when < 0) into a
         serving.FlatEnsemble: stacked per-node tensors + the host-built
@@ -2461,7 +2498,11 @@ class GBDT:
         per-call ``_device_predict_encode`` re-ran on every predict."""
         from ..serving import FlatEnsemble
         models = self.models if num_models < 0 else self.models[:num_models]
-        return FlatEnsemble.from_models(models, self.num_class)
+        flat = FlatEnsemble.from_models(models, self.num_class)
+        # the drift reference rides the flattened ensemble so a
+        # ServingFront can register it without ever touching the booster
+        flat.score_reference = self.capture_score_reference()
+        return flat
 
     def serving_engine(self, num_models: int = -1, **options):
         """The cached compiled serving engine over the first
@@ -2586,6 +2627,17 @@ class GBDT:
             for i in range(max(self._saved_model_size, 0), len(self.models)):
                 self._model_file.write("Tree=%d\n" % i)
                 self._model_file.write(self.models[i].to_string() + "\n")
+            reference = self.capture_score_reference()
+            if reference is not None:
+                # training-time score distribution, the serving drift
+                # detector's comparison baseline (ISSUE 20).  Written at
+                # FINISH, not in the header: the header goes out on the
+                # first incremental save, which would freeze an
+                # early-iteration distribution into the final model
+                # (find_value parses it wherever it sits).
+                self._model_file.write(
+                    "score_reference=%s\n"
+                    % json.dumps(reference, separators=(",", ":")))
             self._model_file.write("\n" + self.feature_importance() + "\n")
             self._model_file.close()
 
@@ -2614,6 +2666,12 @@ class GBDT:
         self.max_feature_idx = int(max_feature_idx)
         sigmoid = find_value("sigmoid=")
         self.sigmoid = float(sigmoid) if sigmoid is not None else -1.0
+        reference = find_value("score_reference=")
+        if reference is not None:
+            try:
+                self.score_reference = json.loads(reference)
+            except Exception:
+                self.score_reference = None
 
         i = 0
         while i < len(lines):

@@ -74,7 +74,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from . import costmodel, lifecycle, telemetry, tracing
+from . import costmodel, lifecycle, monitor, telemetry, tracing
 
 DEFAULT_BUCKETS: Tuple[int, ...] = (1, 32, 1024, 65536)
 
@@ -603,6 +603,18 @@ class _SwapMarker:
         self.t0 = t0
 
 
+def _drift_identity(engine) -> tuple:
+    """(drift key, training-time reference histogram) for one installed
+    engine — the reference is the parsed ``score_reference=`` metadata
+    block, carried on the engine or its FlatEnsemble (None when the
+    model predates capture; the A/A lane still runs without it)."""
+    ref = getattr(engine, "score_reference", None)
+    if ref is None:
+        ref = getattr(getattr(engine, "flat", None),
+                      "score_reference", None)
+    return monitor.engine_key(), ref
+
+
 class ServingFront:
     """Cross-request coalescing front over a ServingEngine (ISSUE 13
     axes b + c — see the module docstring).
@@ -649,6 +661,12 @@ class ServingFront:
                       "coalesced_rows": 0, "queue_peak_rows": 0,
                       "linger_wait_s": 0.0, "swaps": 0,
                       "last_swap_drain_s": None}
+        # score-drift feed (ISSUE 20): each installed engine gets a
+        # fresh drift key so a swapped-in candidate starts a clean live
+        # histogram; the training-time reference (model-file
+        # ``score_reference=`` metadata, carried on the FlatEnsemble)
+        # rides along to monitor.record_scores
+        self._monitor_key, self._monitor_ref = _drift_identity(engine)
         self._thread = threading.Thread(target=self._serve_loop,
                                         name="lgbm-serving-front",
                                         daemon=True)
@@ -822,6 +840,8 @@ class ServingFront:
                     # old engine; everything behind scores on the new one
                     self._queue.popleft()
                     self._engine = head.engine
+                    self._monitor_key, self._monitor_ref = \
+                        _drift_identity(head.engine)
                     head.event.set()
                     tracing.event("serve_swap_flip",
                                   drain_us=int((time.perf_counter()
@@ -853,6 +873,7 @@ class ServingFront:
                 self._queued_rows -= total
                 depth_after = self._queued_rows
                 engine = self._engine
+                mon_key, mon_ref = self._monitor_key, self._monitor_ref
                 self._cond.notify_all()        # wake blocked submitters
             # device work runs OUTSIDE the lock: submit stays wait-free
             # while a batch is on device
@@ -892,6 +913,11 @@ class ServingFront:
                         pass
                 continue
             tracing.end_batch()
+            if monitor.active():
+                # live drift feed: every delivered score lands in this
+                # engine's signed log-bucket histogram (A/A halves split
+                # inside) — outside the front lock, after device work
+                monitor.record_scores(mon_key, scores, reference=mon_ref)
             t_scores_ns = time.perf_counter_ns()
             if bt is not None:
                 tracing.event("serve_batch", batch=bt.batch_id,

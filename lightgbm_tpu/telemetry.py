@@ -266,6 +266,9 @@ COUNTER_FAMILIES = (
     "jit/midrun_recompile",
     "jit/persistent_cache_hit",
     "learner/fp_*",               # feature-parallel ownership routes
+    "monitor/drift_scores",
+    "monitor/slo_breaches",
+    "monitor/windows",
     "partition/dma_overlap",
     "partition/dma_serial",
     "partition/env_no_pallas",
@@ -476,6 +479,14 @@ def disable() -> None:
     global _enabled, _fence, _sink_file, _sink_path, _memory
     global _timeline, _shard_path_used, _wd_timeout_cfg
     disarm_watchdog()
+    try:
+        # flush the live monitor FIRST: its tail window files
+        # monitor_window / slo_breach events into the trace ring, so
+        # they must land before the recorder's close dump below
+        from . import monitor
+        monitor.disarm()
+    except Exception:
+        pass
     try:
         from . import tracing
         # stamp the session's per-site wire byte model into the ring

@@ -484,6 +484,33 @@ def sketch(family: str) -> Optional[LatencySketch]:
         return _sketches.get(family)
 
 
+def cumulative_state() -> Optional[dict]:
+    """One consistent copy of the recorder's cumulative tallies —
+    per-family sketches (deep-copied, caller-owned) and the small bump
+    counters — read under a SINGLE lock acquisition, so a windowed
+    consumer (monitor.py) can subtract two calls and get exact interval
+    deltas: no counter can advance between the sketch copy and the
+    counter copy.  None while disarmed."""
+    if not _armed:
+        return None
+    with _lock:
+        if not _armed:
+            return None
+        sketches = {}
+        for fam, sk in _sketches.items():
+            cp = LatencySketch(sk.growth)
+            cp.zero = sk.zero
+            cp.buckets = dict(sk.buckets)
+            sketches[fam] = cp
+        return {
+            "sketches": sketches,
+            "counters": dict(_counters),
+            "appended": _appended,
+            "dropped": max(0, _appended - _cap),
+            "sketch_growth": _growth,
+        }
+
+
 # ------------------------------------------------------- batch trace (TLS)
 
 class BatchTrace:

@@ -1,0 +1,504 @@
+"""Render / validate live-monitor JSONL (ISSUE 20).
+
+Usage:
+    python scripts/monitor_report.py monitor.jsonl
+    python scripts/monitor_report.py --json  monitor.jsonl
+    python scripts/monitor_report.py --check monitor.jsonl [more...]
+
+A monitor file (lightgbm_tpu/monitor.py, appended by the emitter thread
+and closed on ``telemetry.disable()`` or from the faults.py crash path)
+is one ``monitor_header`` line, then one ``monitor_window`` line per
+closed interval — counter deltas, per-family latency-sketch deltas, the
+SLO burn evaluation — and a final ``monitor_close`` line carrying the
+serialized drift state.  The default mode prints the windowed series
+(per-window percentiles of the SLO family, burn rates, breach marks)
+and the close record's drift verdicts.
+
+``--check`` validates the monitor's hard contracts and exits 1 on any
+violation (2 on unreadable input), printing one line per finding:
+
+  - unparseable JSONL, or a first line that is not a ``monitor_header``;
+  - window ids not starting at 1 / not advancing by exactly 1;
+  - a negative counter delta or a negative sketch-bucket delta — window
+    deltas difference two monotone cumulative states, so negatives mean
+    mixed baselines, never rounding;
+  - delta/total conservation: for every counter and sketch family,
+    ``total[w] == total[w-1] + delta[w]`` (a registry reset rebases the
+    delta to the full total — tolerated, but only as the all-or-nothing
+    rebase the monitor itself performs);
+  - SLO burn arithmetic: the recorded per-window ``bad``/``total`` and
+    the fast/slow burn rates are recomputed exactly from the emitted
+    delta sketches (the same integers the monitor summed) and must
+    match; the breach flag must equal ``fast >= FAST and slow >= SLOW``
+    per the header's thresholds;
+  - close-record bookkeeping: ``windows``/``emitted``/``breaches``
+    must match the window lines actually present, at most one close,
+    and no window lines after it;
+  - drift verdicts: every recorded ``psi``/``aa_psi``/flag in the close
+    record is RE-DERIVED from the serialized reference/live/A-A bucket
+    maps — a tampered reference or a hand-edited verdict cannot agree
+    with its own buckets; the A/A halves must also partition the live
+    histogram (``a.count + b.count == live.count``).
+
+Standalone stdlib script (schema constants mirror lightgbm_tpu.monitor)
+so it runs anywhere, including on files scp'd off a crashed host.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+
+# mirror lightgbm_tpu.monitor — inline so the script stays
+# dependency-free on crash-forensics hosts
+SLO_BUDGET = 0.01
+FAST_BURN = 5.0
+SLOW_BURN = 1.0
+BURN_TOL = 1e-9
+
+
+class BadDump(Exception):
+    pass
+
+
+def load(path: str):
+    """-> (header, [window dicts], close-or-None, trailing-line count).
+    Raises BadDump on junk."""
+    try:
+        f = open(path)
+    except OSError as e:
+        raise BadDump("cannot read %s: %s" % (path, e))
+    header, windows, close = None, [], None
+    after_close = 0
+    with f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError as e:
+                raise BadDump("%s:%d: unparseable JSONL (%s)"
+                              % (path, lineno, e))
+            if lineno == 1:
+                if not isinstance(rec, dict) or "monitor_header" not in rec:
+                    raise BadDump("%s:1: first line is not a monitor_header"
+                                  % path)
+                header = rec["monitor_header"]
+            elif isinstance(rec, dict) and "monitor_window" in rec:
+                if close is not None:
+                    after_close += 1
+                windows.append(rec["monitor_window"])
+            elif isinstance(rec, dict) and "monitor_close" in rec:
+                if close is not None:
+                    after_close += 1
+                close = rec["monitor_close"]
+            else:
+                raise BadDump("%s:%d: line is neither monitor_window nor "
+                              "monitor_close" % (path, lineno))
+    if header is None:
+        raise BadDump("%s: empty file (no monitor_header line)" % path)
+    return header, windows, close, after_close
+
+
+# ---------------------------------------------------------------- sketches
+
+def _sketch_count(sk: dict) -> int:
+    return int(sk.get("zero", 0)) + sum(
+        int(c) for c in (sk.get("buckets") or {}).values())
+
+
+def _sketch_bad(sk: dict, threshold_us: float) -> int:
+    g = float(sk.get("growth", 1.05))
+    return sum(int(c) for i, c in (sk.get("buckets") or {}).items()
+               if g ** (int(i) + 0.5) > threshold_us)
+
+
+def _sketch_quantile(sk: dict, q: float):
+    zero = int(sk.get("zero", 0))
+    buckets = {int(i): int(c) for i, c in (sk.get("buckets") or {}).items()}
+    total = zero + sum(buckets.values())
+    if total == 0:
+        return None
+    rank = min(total - 1, max(0, int(math.ceil(q * total)) - 1))
+    if rank < zero:
+        return 0.0
+    g = float(sk.get("growth", 1.05))
+    seen = zero
+    for i in sorted(buckets):
+        seen += buckets[i]
+        if rank < seen:
+            return g ** (i + 0.5)
+    return None
+
+
+# ------------------------------------------------------------------- drift
+
+def _hist_count(h: dict) -> int:
+    return (int(h.get("zero", 0))
+            + sum(int(c) for c in (h.get("pos") or {}).values())
+            + sum(int(c) for c in (h.get("neg") or {}).values()))
+
+
+def psi(ref: dict, live: dict, epsilon: float = 1e-4):
+    """Recompute the PSI divergence from two serialized score
+    histograms — the independent arithmetic the recorded verdicts must
+    agree with (mirrors lightgbm_tpu.monitor.psi)."""
+    if not ref or not live:
+        return None
+    rt, lt = _hist_count(ref), _hist_count(live)
+    if rt == 0 or lt == 0:
+        return None
+    keys = {("z", 0)}
+    for h in (ref, live):
+        keys.update(("p", int(i)) for i in (h.get("pos") or {}))
+        keys.update(("n", int(i)) for i in (h.get("neg") or {}))
+    k = len(keys)
+    total = 0.0
+    for sign, i in keys:
+        if sign == "z":
+            rc, lc = int(ref.get("zero", 0)), int(live.get("zero", 0))
+        else:
+            side = "pos" if sign == "p" else "neg"
+            rc = int((ref.get(side) or {}).get(str(i), 0))
+            lc = int((live.get(side) or {}).get(str(i), 0))
+        p = (rc + epsilon) / (rt + epsilon * k)
+        q = (lc + epsilon) / (lt + epsilon * k)
+        total += (q - p) * math.log(q / p)
+    return total
+
+
+def _close_to(a, b, tol: float = BURN_TOL) -> bool:
+    if a is None or b is None:
+        return a is None and b is None
+    return abs(float(a) - float(b)) <= tol * max(1.0, abs(float(a)),
+                                                 abs(float(b)))
+
+
+# ------------------------------------------------------------------- check
+
+def _check_conservation(path, what, wid, total, prev_total, delta, bad):
+    """Shared counter/sketch-count conservation: totals are monotone
+    cumulative, deltas difference them — except the all-or-nothing
+    rebase after a registry reset, where deltas equal the new totals."""
+    rebased = any(total.get(k, 0) < v for k, v in prev_total.items())
+    expect = {}
+    base = {} if rebased else prev_total
+    for k, v in total.items():
+        d = v - base.get(k, 0)
+        if d:
+            expect[k] = d
+    if delta != expect:
+        bad.append("%s: window %s %s deltas %r do not conserve against "
+                   "totals (expected %r%s)"
+                   % (path, wid, what, delta, expect,
+                      ", rebased baseline" if rebased else ""))
+
+
+def check(path: str, header: dict, windows: list, close, after_close: int
+          ) -> list:
+    """All contract violations in one monitor file (empty = clean)."""
+    bad = []
+    if not isinstance(header.get("interval_s"), (int, float)) \
+            or header.get("interval_s", 0) <= 0:
+        bad.append("%s: header interval_s=%r is not positive"
+                   % (path, header.get("interval_s")))
+    slo = header.get("slo")
+    if slo is not None:
+        for key in ("p99_us", "window_s"):
+            if not isinstance(slo.get(key), (int, float)) \
+                    or slo.get(key, 0) <= 0:
+                bad.append("%s: header slo.%s=%r is not positive"
+                           % (path, key, slo.get(key)))
+        if slo.get("short_windows", 1) > slo.get("long_windows", 1):
+            bad.append("%s: header slo short_windows=%r > long_windows=%r"
+                       % (path, slo.get("short_windows"),
+                          slo.get("long_windows")))
+    if after_close:
+        bad.append("%s: %d record(s) after the monitor_close line"
+                   % (path, after_close))
+
+    prev_counters = {}
+    prev_sketch_counts = {}
+    breach_seen = 0
+    for pos, w in enumerate(windows):
+        wid = w.get("window")
+        if wid != pos + 1:
+            bad.append("%s: window id %r at position %d (expected %d — ids "
+                       "start at 1 and advance by 1)"
+                       % (path, wid, pos + 1, pos + 1))
+        counters = w.get("counters") or {}
+        totals = w.get("counters_total") or {}
+        neg = [k for k, v in counters.items() if v < 0]
+        if neg:
+            bad.append("%s: window %s negative counter delta(s) %s"
+                       % (path, wid, ",".join(sorted(neg))))
+        else:
+            if pos == 0:
+                # unknown arm-time baseline: deltas can only be bounded
+                for k, v in counters.items():
+                    if v > totals.get(k, 0):
+                        bad.append("%s: window %s first-window delta %s=%d "
+                                   "exceeds its cumulative total %d"
+                                   % (path, wid, k, v, totals.get(k, 0)))
+            else:
+                _check_conservation(path, "counter", wid, totals,
+                                    prev_counters, counters, bad)
+        prev_counters = totals
+
+        sketches = w.get("sketches") or {}
+        sk_totals = w.get("sketch_counts_total") or {}
+        sk_deltas = {}
+        for fam, sk in sketches.items():
+            if int(sk.get("zero", 0)) < 0 or any(
+                    int(c) < 0 for c in (sk.get("buckets") or {}).values()):
+                bad.append("%s: window %s negative sketch delta in family "
+                           "%s" % (path, wid, fam))
+            cnt = _sketch_count(sk)
+            if cnt:
+                sk_deltas[fam] = cnt
+        if pos == 0:
+            for fam, cnt in sk_deltas.items():
+                if cnt > sk_totals.get(fam, 0):
+                    bad.append("%s: window %s first-window sketch delta "
+                               "%s=%d exceeds its cumulative count %d"
+                               % (path, wid, fam, cnt,
+                                  sk_totals.get(fam, 0)))
+        else:
+            _check_conservation(path, "sketch-count", wid, sk_totals,
+                                prev_sketch_counts, sk_deltas, bad)
+        prev_sketch_counts = sk_totals
+
+        wslo = w.get("slo")
+        if wslo is None:
+            if slo is not None:
+                bad.append("%s: window %s missing its slo block (header "
+                           "declares an objective)" % (path, wid))
+            continue
+        if slo is None:
+            bad.append("%s: window %s carries an slo block but the header "
+                       "declares no objective" % (path, wid))
+            continue
+        fam = wslo.get("family")
+        p99 = float(wslo.get("p99_us", 0))
+        sk = sketches.get(fam)
+        want_bad = 0 if sk is None else _sketch_bad(sk, p99)
+        want_total = 0 if sk is None else _sketch_count(sk)
+        if wslo.get("bad") != want_bad or wslo.get("total") != want_total:
+            bad.append("%s: window %s slo bad/total %r/%r do not match the "
+                       "window sketch (%d/%d)"
+                       % (path, wid, wslo.get("bad"), wslo.get("total"),
+                          want_bad, want_total))
+        # recompute both burn rates over the trailing windows — the same
+        # integer sums the monitor performed over its ring
+        for label, nw, want_thresh in (
+                ("fast", int(slo.get("short_windows", 1)), FAST_BURN),
+                ("slow", int(slo.get("long_windows", 1)), SLOW_BURN)):
+            b = t = 0
+            for back in windows[max(0, pos + 1 - nw):pos + 1]:
+                bsk = (back.get("sketches") or {}).get(fam)
+                if not bsk:
+                    continue
+                b += _sketch_bad(bsk, p99)
+                t += _sketch_count(bsk)
+            want = 0.0 if t == 0 else (b / t) / SLO_BUDGET
+            got = wslo.get("%s_burn" % label)
+            if not isinstance(got, (int, float)) or not _close_to(got, want):
+                bad.append("%s: window %s %s_burn=%r but recomputing over "
+                           "the trailing %d window(s) gives %.6g"
+                           % (path, wid, label, got, nw, want))
+        want_breach = bool(
+            isinstance(wslo.get("fast_burn"), (int, float))
+            and isinstance(wslo.get("slow_burn"), (int, float))
+            and wslo["fast_burn"] >= float(slo.get("fast_burn", FAST_BURN))
+            and wslo["slow_burn"] >= float(slo.get("slow_burn", SLOW_BURN)))
+        if bool(wslo.get("breach")) != want_breach:
+            bad.append("%s: window %s breach=%r contradicts its own burn "
+                       "rates (fast=%r slow=%r)"
+                       % (path, wid, wslo.get("breach"),
+                          wslo.get("fast_burn"), wslo.get("slow_burn")))
+        if wslo.get("breach"):
+            breach_seen += 1
+
+    if close is not None:
+        last = windows[-1]["window"] if windows else 0
+        if close.get("windows") != last:
+            bad.append("%s: close says %r windows but the last window line "
+                       "is id %r (disarm ticks the tail window first, so "
+                       "they must agree)" % (path, close.get("windows"),
+                                             last))
+        if close.get("emitted") != len(windows):
+            bad.append("%s: close says emitted=%r but %d window lines are "
+                       "present" % (path, close.get("emitted"),
+                                    len(windows)))
+        if close.get("breaches") != breach_seen:
+            bad.append("%s: close says breaches=%r but %d window(s) carry "
+                       "breach=true" % (path, close.get("breaches"),
+                                        breach_seen))
+        for key, d in sorted((close.get("drift") or {}).items()):
+            live = d.get("live") or {}
+            a, b = d.get("a") or {}, d.get("b") or {}
+            if _hist_count(a) + _hist_count(b) != _hist_count(live):
+                bad.append("%s: drift %s A/A halves (%d + %d) do not "
+                           "partition the live histogram (%d)"
+                           % (path, key, _hist_count(a), _hist_count(b),
+                              _hist_count(live)))
+            want_psi = psi(d.get("reference"), live)
+            if not _close_to(d.get("psi"), want_psi):
+                bad.append("%s: drift %s recorded psi=%r but the "
+                           "serialized reference/live buckets give %r — "
+                           "tampered reference or verdict"
+                           % (path, key, d.get("psi"), want_psi))
+            thresh = d.get("threshold")
+            want_flag = bool(want_psi is not None
+                             and isinstance(thresh, (int, float))
+                             and want_psi > thresh)
+            if bool(d.get("drift")) != want_flag:
+                bad.append("%s: drift %s flag=%r contradicts psi=%r vs "
+                           "threshold=%r" % (path, key, d.get("drift"),
+                                             want_psi, thresh))
+            want_aa = psi(a, b)
+            if not _close_to(d.get("aa_psi"), want_aa):
+                bad.append("%s: drift %s recorded aa_psi=%r but the A/A "
+                           "buckets give %r" % (path, key, d.get("aa_psi"),
+                                                want_aa))
+    return bad
+
+
+# ------------------------------------------------------------------ render
+
+def summarize(header: dict, windows: list, close) -> dict:
+    slo = header.get("slo")
+    fam = (slo or {}).get("family") or "serve_wall_us"
+    series = []
+    for w in windows:
+        sk = (w.get("sketches") or {}).get(fam)
+        row = {
+            "window": w.get("window"),
+            "dur_s": round(float(w.get("t1", 0)) - float(w.get("t0", 0)), 3),
+            "count": 0 if sk is None else _sketch_count(sk),
+            "p50_us": None if sk is None else _sketch_quantile(sk, 0.50),
+            "p99_us": None if sk is None else _sketch_quantile(sk, 0.99),
+            "counters": w.get("counters") or {},
+        }
+        if w.get("slo"):
+            row["fast_burn"] = w["slo"].get("fast_burn")
+            row["slow_burn"] = w["slo"].get("slow_burn")
+            row["breach"] = bool(w["slo"].get("breach"))
+        series.append(row)
+    out = {
+        "interval_s": header.get("interval_s"),
+        "run_id": header.get("run_id"),
+        "host": header.get("host"),
+        "pid": header.get("pid"),
+        "slo": slo,
+        "family": fam,
+        "windows": series,
+        "breaches": sum(1 for r in series if r.get("breach")),
+    }
+    if close is not None:
+        out["close"] = {
+            "reason": close.get("reason"),
+            "windows": close.get("windows"),
+            "breaches": close.get("breaches"),
+            "drift": {
+                key: {"n": d.get("n"), "psi": d.get("psi"),
+                      "drift": d.get("drift"), "aa_psi": d.get("aa_psi"),
+                      "aa_bound": d.get("aa_bound")}
+                for key, d in sorted((close.get("drift") or {}).items())},
+        }
+    return out
+
+
+def render(path: str, s: dict) -> str:
+    lines = ["monitor report: %s" % path,
+             "interval=%ss host=%s pid=%s run_id=%r  windows=%d "
+             "breaches=%d"
+             % (s.get("interval_s"), s.get("host"), s.get("pid"),
+                s.get("run_id") or "", len(s.get("windows") or []),
+                s.get("breaches", 0))]
+    slo = s.get("slo")
+    if slo:
+        lines.append("slo: %s p99 <= %gus over %gs (fast %gx over %d "
+                     "window(s), slow %gx over %d)"
+                     % (slo.get("family"), slo.get("p99_us"),
+                        slo.get("window_s"), slo.get("fast_burn"),
+                        slo.get("short_windows"), slo.get("slow_burn"),
+                        slo.get("long_windows")))
+    lines += ["", "Windowed series (%s)" % s.get("family"),
+              "-" * (18 + len(str(s.get("family"))))]
+
+    def _f(x, fmt="%9.1f"):
+        return (fmt % x) if isinstance(x, (int, float)) else "%9s" % "-"
+
+    lines.append("%6s  %7s  %7s  %9s  %9s  %9s  %9s  %s"
+                 % ("window", "dur s", "count", "p50 us", "p99 us",
+                    "fast", "slow", "breach"))
+    for r in s.get("windows") or []:
+        lines.append("%6s  %7.3f  %7d  %s  %s  %s  %s  %s"
+                     % (r["window"], r["dur_s"], r["count"],
+                        _f(r["p50_us"]), _f(r["p99_us"]),
+                        _f(r.get("fast_burn"), "%9.3f"),
+                        _f(r.get("slow_burn"), "%9.3f"),
+                        "BREACH" if r.get("breach") else ""))
+    if not s.get("windows"):
+        lines.append("(no windows)")
+    close = s.get("close")
+    if close:
+        lines += ["", "Close (%s)" % close.get("reason"),
+                  "------------------"]
+        for key, d in sorted((close.get("drift") or {}).items()):
+            lines.append("%s: n=%s psi=%s drift=%s aa_psi=%s (bound %s)"
+                         % (key, d.get("n"),
+                            "-" if d.get("psi") is None
+                            else "%.4f" % d["psi"],
+                            d.get("drift"),
+                            "-" if d.get("aa_psi") is None
+                            else "%.4f" % d["aa_psi"],
+                            d.get("aa_bound")))
+        if not close.get("drift"):
+            lines.append("(no drift state)")
+    else:
+        lines += ["", "(no close record — emitter still live, or the "
+                      "process died before the fault hatch could flush)"]
+    return "\n".join(lines)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("paths", nargs="+", help="monitor JSONL file(s)")
+    p.add_argument("--check", action="store_true",
+                   help="validate contracts; exit 1 on any violation")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable summary instead of tables")
+    args = p.parse_args()
+    findings = []
+    for path in args.paths:
+        try:
+            header, windows, close, after = load(path)
+        except BadDump as e:
+            if args.check:
+                findings.append(str(e))
+                continue
+            print("monitor_report error: %s" % e, file=sys.stderr)
+            return 2
+        if args.check:
+            findings.extend(check(path, header, windows, close, after))
+            continue
+        s = summarize(header, windows, close)
+        if args.json:
+            print(json.dumps({"path": path, **s}))
+        else:
+            print(render(path, s))
+    if args.check:
+        for f in findings:
+            print("MONITOR-CHECK FAIL %s" % f)
+        if findings:
+            return 1
+        print("monitor-check ok: %d file(s) clean" % len(args.paths))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -146,7 +146,17 @@ LATENCY_KEYS: Tuple[Tuple[str, str], ...] = (
     # is only honest while this stays flat — gated must-not-grow at the
     # wide observability floor (a small difference of two noisy rates).
     ("trace_overhead_pct", "trace_spread"),
+    # live-monitor cost (ISSUE 20, bench.py --bench-serve): percent
+    # serve throughput lost with the monitor armed ON TOP of the
+    # recorder, from interleaved monitor-on/off segments — same honesty
+    # contract as trace_overhead_pct, same wide band.
+    ("monitor_overhead_pct", "monitor_spread"),
 )
+
+# mirror of lightgbm_tpu.monitor.AA_PSI_BOUND — the documented A/A
+# false-positive bound the bench's drift_aa_psi must stay under (kept
+# inline: the gate runs on hosts without the package)
+AA_PSI_BOUND = 0.05
 
 # absolute zero-tolerance keys (no trajectory needed): any nonzero on
 # the LATEST round is a finding.  predict/serve recompiles break the
@@ -290,6 +300,22 @@ def _attach_multichip_obs(rec: dict) -> None:
             if isinstance(pt, dict):
                 rec["podtrace"] = pt
             break
+    if "monitor" not in rec:
+        # ISSUE 20: the live-monitor row prints one MULTICHIP_MONITOR
+        # JSON line (induced latency bulge -> SLO burn breach;
+        # shifted-score swap -> drift verdict; A/A self-check under its
+        # bound; monitor_report/trace_report --check clean)
+        for line in reversed(lines):
+            line = line.strip()
+            if not line.startswith("MULTICHIP_MONITOR "):
+                continue
+            try:
+                mon = json.loads(line[len("MULTICHIP_MONITOR "):])
+            except ValueError:
+                break
+            if isinstance(mon, dict):
+                rec["monitor"] = mon
+            break
     if "sharded_ingest" not in rec:
         # ISSUE 18: the multi-host sharded-ingest row prints one
         # MULTICHIP_SHARDED_INGEST JSON line (every rank parses only
@@ -384,6 +410,7 @@ def _check_group(metric: str, entries: List[dict], floor: float,
                 })
     _check_mixedbin_resolution(metric, entries[-1], findings)
     _check_ingest_workers(metric, entries, findings)
+    _check_drift_slo(metric, entries[-1], findings)
     if len(entries) < 2:
         return
     latest_round = entries[-1]["round"]
@@ -468,6 +495,41 @@ def _check_mixedbin_resolution(metric: str, latest: dict,
                           "uniform layout (block-local packing silently "
                           "fell back)" % (learner, requested),
             })
+
+
+def _check_drift_slo(metric: str, latest: dict,
+                     findings: List[dict]) -> None:
+    """ISSUE 20 absolute findings on the latest bench round, no
+    trajectory needed: ``drift_aa_psi`` above the documented A/A bound
+    means the score-drift detector's false-positive floor rose past its
+    own spec (every production swap would risk a spurious drift page),
+    and ``monitor_slo_breaches > 0`` on a round that did NOT declare an
+    induced fault means the generous bench SLO (20x the measured
+    healthy p99) burned on healthy load — either the serving path
+    developed a real bulge or the burn arithmetic broke."""
+    rec = latest["rec"]
+    aa = rec.get("drift_aa_psi")
+    if isinstance(aa, (int, float)) and aa > AA_PSI_BOUND:
+        findings.append({
+            "metric": metric, "key": "drift_aa_psi",
+            "latest_round": latest["round"],
+            "latest": aa, "baseline": AA_PSI_BOUND,
+            "detail": "A/A self-check PSI %.4g exceeds the documented "
+                      "false-positive bound %.2g — same-distribution "
+                      "halves look drifted, so every real drift verdict "
+                      "is suspect" % (aa, AA_PSI_BOUND),
+        })
+    breaches = rec.get("monitor_slo_breaches")
+    if isinstance(breaches, (int, float)) and breaches > 0 \
+            and not rec.get("monitor_induced_fault"):
+        findings.append({
+            "metric": metric, "key": "monitor_slo_breaches",
+            "latest_round": latest["round"],
+            "latest": breaches, "baseline": 0,
+            "detail": "SLO burn-rate breach(es) fired on a healthy "
+                      "bench round with no declared induced fault — the "
+                      "20x-generous objective burned on steady load",
+        })
 
 
 def _check_ingest_workers(metric: str, entries: List[dict],
@@ -764,6 +826,56 @@ def _check_sharded_ingest(entries: List[dict],
                 })
 
 
+def _check_monitor(entries: List[dict], findings: List[dict]) -> None:
+    """ISSUE 20: the live-monitor row from the MULTICHIP_MONITOR block.
+    Absolute per-round contracts (correctness claims about that round's
+    smoke, not trajectories): the induced latency bulge must trip the
+    fast+slow burn rule, the shifted-score swap must trip the PSI drift
+    verdict, the healthy engine's A/A self-check must hold under its
+    bound, and both the monitor_report and trace_report checkers must
+    come back clean (delta/total conservation, burn arithmetic,
+    re-derived drift verdicts, slo_breach <-> monitor_window linkage)."""
+    for e in sorted(entries, key=lambda e: e["round"]):
+        mon = e["rec"].get("monitor")
+        if not isinstance(mon, dict):
+            continue
+        checks = (
+            ("breaches",
+             isinstance(mon.get("breaches"), (int, float))
+             and mon["breaches"] < 1, mon.get("breaches"),
+             "the induced latency bulge did not trip the fast+slow SLO "
+             "burn rule — the monitor missed the exact failure it "
+             "exists for"),
+            ("drift", mon.get("drift") is False, mon.get("drift"),
+             "the shifted-score engine swap did not trip the PSI drift "
+             "verdict"),
+            ("aa_ok", mon.get("aa_ok") is False, mon.get("aa_psi"),
+             "the healthy engine's A/A self-check exceeded its "
+             "false-positive bound"),
+            ("check_findings",
+             isinstance(mon.get("check_findings"), (int, float))
+             and mon["check_findings"] > 0, mon.get("check_findings"),
+             "monitor_report --check flagged contract violations "
+             "(delta/total conservation, burn arithmetic, or a drift "
+             "verdict disagreeing with its own buckets)"),
+            ("trace_check_findings",
+             isinstance(mon.get("trace_check_findings"), (int, float))
+             and mon["trace_check_findings"] > 0,
+             mon.get("trace_check_findings"),
+             "trace_report --check flagged the monitored round's dump "
+             "(slo_breach <-> monitor_window linkage or ring "
+             "contracts)"),
+        )
+        for key, bad, latest, detail in checks:
+            if bad:
+                findings.append({
+                    "metric": "multichip", "key": "monitor/" + key,
+                    "latest_round": e["round"],
+                    "latest": latest, "baseline": None,
+                    "detail": detail,
+                })
+
+
 def _check_wire(entries: List[dict], findings: List[dict],
                 floor: float = DEFAULT_FLOOR,
                 sigma_mult: float = DEFAULT_SIGMA_MULT) -> None:
@@ -844,6 +956,7 @@ def check_files(paths: List[str], floor: float = DEFAULT_FLOOR,
         _check_podtrace(multichip, findings, floor=floor,
                         sigma_mult=sigma_mult)
         _check_sharded_ingest(multichip, findings)
+        _check_monitor(multichip, findings)
     return {
         "files": len(entries),
         "groups": {m: len(g) for m, g in sorted(groups.items())},

@@ -28,6 +28,14 @@ trailing {"summary": true, ...} record) and prints:
     share of the request wall time),
   - first/last eval metric values per dataset/metric.
 
+``--monitor monitor.jsonl`` additionally renders the live monitor's
+windowed snapshot series (ISSUE 20, monitor_out= JSONL): one row per
+closed window with the SLO family's delta-sketch count and p50/p99,
+the fast/slow burn rates and breach marks.  Works standalone too
+(``--monitor`` with no positional path).  The full contract validator
+is ``scripts/monitor_report.py --check``; this is the human render
+next to the phase tables.
+
 Malformed or truncated JSONL exits with a one-line error (code 2), not a
 stack trace — half-written sinks from crashed runs are an expected input.
 """
@@ -487,13 +495,124 @@ def report(path: str, as_json: bool = False) -> int:
     return 0
 
 
+def _monitor_lines(path):
+    """The live monitor's windowed snapshot series (ISSUE 20,
+    ``monitor_out=`` JSONL): per-window SLO-family delta-sketch count
+    and p50/p99, burn rates and breach marks.  Percentiles come from
+    the emitted window sketches — exact per-bucket deltas of the
+    recorder's cumulative sketches, same resolution contract."""
+    import math
+
+    def _quantile(sk, q):
+        zero = int(sk.get("zero", 0))
+        buckets = {int(i): int(c)
+                   for i, c in (sk.get("buckets") or {}).items()}
+        total = zero + sum(buckets.values())
+        if total == 0:
+            return None
+        rank = min(total - 1, max(0, int(math.ceil(q * total)) - 1))
+        if rank < zero:
+            return 0.0
+        g, seen = float(sk.get("growth", 1.05)), zero
+        for i in sorted(buckets):
+            seen += buckets[i]
+            if rank < seen:
+                return g ** (i + 0.5)
+        return None
+
+    try:
+        f = open(path)
+    except OSError as e:
+        raise MalformedJSONL(f"cannot read {path}: {e}")
+    header, windows, close = None, [], None
+    with f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError as e:
+                raise MalformedJSONL(f"{path}:{lineno}: bad JSONL ({e})")
+            if isinstance(rec, dict) and "monitor_header" in rec:
+                header = rec["monitor_header"]
+            elif isinstance(rec, dict) and "monitor_window" in rec:
+                windows.append(rec["monitor_window"])
+            elif isinstance(rec, dict) and "monitor_close" in rec:
+                close = rec["monitor_close"]
+    if header is None:
+        raise MalformedJSONL(f"{path}: no monitor_header line")
+    slo = header.get("slo")
+    fam = (slo or {}).get("family") or "serve_wall_us"
+    out = ["Live monitor (windowed, %s)" % fam,
+           "-" * (25 + len(fam)),
+           "interval %ss  %d window(s)%s"
+           % (header.get("interval_s"), len(windows),
+              "  slo p99<=%gus/%gs" % (slo["p99_us"], slo["window_s"])
+              if slo else "")]
+
+    def _us(x):
+        return ("%9.1f" % x) if isinstance(x, (int, float)) else "%9s" % "-"
+
+    out.append("%6s  %7s  %9s  %9s  %8s  %8s  %s"
+               % ("window", "count", "p50 us", "p99 us", "fast", "slow",
+                  "breach"))
+    for w in windows:
+        sk = (w.get("sketches") or {}).get(fam)
+        ws = w.get("slo") or {}
+        out.append("%6s  %7d  %s  %s  %8s  %8s  %s"
+                   % (w.get("window"),
+                      0 if sk is None else (
+                          int(sk.get("zero", 0))
+                          + sum(int(c) for c in
+                                (sk.get("buckets") or {}).values())),
+                      _us(None if sk is None else _quantile(sk, 0.50)),
+                      _us(None if sk is None else _quantile(sk, 0.99)),
+                      ("%.3f" % ws["fast_burn"])
+                      if isinstance(ws.get("fast_burn"),
+                                    (int, float)) else "-",
+                      ("%.3f" % ws["slow_burn"])
+                      if isinstance(ws.get("slow_burn"),
+                                    (int, float)) else "-",
+                      "BREACH" if ws.get("breach") else ""))
+    if not windows:
+        out.append("(no closed windows)")
+    if close is not None:
+        out.append("close: reason=%s windows=%s breaches=%s"
+                   % (close.get("reason"), close.get("windows"),
+                      close.get("breaches")))
+        for key, d in sorted((close.get("drift") or {}).items()):
+            out.append("  drift %s: n=%s psi=%s drift=%s aa_psi=%s"
+                       % (key, d.get("n"),
+                          "-" if d.get("psi") is None
+                          else "%.4f" % d["psi"], d.get("drift"),
+                          "-" if d.get("aa_psi") is None
+                          else "%.4f" % d["aa_psi"]))
+    return out
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
-    p.add_argument("path", help="telemetry JSONL file (metrics_out=...)")
+    p.add_argument("path", nargs="?", default=None,
+                   help="telemetry JSONL file (metrics_out=...)")
+    p.add_argument("--monitor", metavar="JSONL", default=None,
+                   help="also render a live-monitor windowed series "
+                        "(monitor_out= JSONL, ISSUE 20)")
     p.add_argument("--json", action="store_true",
                    help="machine-readable aggregate instead of tables")
     args = p.parse_args()
-    return report(args.path, as_json=args.json)
+    if args.path is None and args.monitor is None:
+        p.error("need a telemetry JSONL path and/or --monitor")
+    rc = 0
+    if args.path is not None:
+        rc = report(args.path, as_json=args.json)
+    if args.monitor is not None:
+        try:
+            print("\n".join(_monitor_lines(args.monitor)))
+        except MalformedJSONL as e:
+            print(f"telemetry_report error: {e}", file=sys.stderr)
+            return 2
+    return rc
 
 
 if __name__ == "__main__":

@@ -26,6 +26,11 @@ violation (2 on unreadable input), printing one line per finding:
     tolerated — the ring drops oldest-first by design);
   - header bookkeeping: ``events`` not matching the event lines actually
     present, or ``dropped != max(0, appended - events)``;
+  - live-monitor linkage (ISSUE 20): an ``slo_breach`` event without an
+    integer ``window`` id, or one whose id has no matching
+    ``monitor_window`` event in a dump whose header says nothing was
+    dropped (the monitor files both into the same ring, breach after
+    marker, so a complete ring must contain the pair);
   - host/process identity bookkeeping (pod-scope dumps, ISSUE 17): a
     ``process_index`` that is not an int in ``[0, process_count)``, a
     non-positive ``process_count``, or a non-string host/run_id;
@@ -152,6 +157,21 @@ def check(path: str, header: dict, events: list) -> list:
             bad.append("%s: trace %s enqueue at line %d AFTER its "
                        "completion at line %d"
                        % (path, tid, pos_enq + 2, pos + 2))
+    # live-monitor linkage (ISSUE 20): every slo_breach must point at a
+    # monitor_window the ring retained — dropped>0 may have evicted the
+    # marker, so the id check only binds on complete rings
+    window_ids = {ev.get("window") for ev in events
+                  if ev.get("kind") == "monitor_window"}
+    for ev in events:
+        if ev.get("kind") != "slo_breach":
+            continue
+        wid = ev.get("window")
+        if not isinstance(wid, int):
+            bad.append("%s: slo_breach event without an integer window id "
+                       "(%r)" % (path, wid))
+        elif dropped == 0 and wid not in window_ids:
+            bad.append("%s: slo_breach window=%d has no monitor_window "
+                       "event in a dump with dropped=0" % (path, wid))
     return bad
 
 
